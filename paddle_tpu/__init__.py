@@ -1,0 +1,62 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability target: PaddlePaddle (reference at /root/reference, see
+/root/repo/SURVEY.md). Architecture: jax/XLA for the compute path (every op
+is a jnp/lax lowering, fused by XLA), Pallas for hot fused kernels, a single
+jax.sharding.Mesh for all 4-D+ hybrid parallelism, and a stateful
+Tensor/Layer facade giving paddle's eager UX on top of jax's functional core.
+
+Top-level namespace mirrors `import paddle`.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import (Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled,
+                   set_grad_enabled, CPUPlace, TPUPlace, CustomPlace,
+                   set_flags, get_flags)
+from .core.place import (set_device, get_device, device_count,
+                         is_compiled_with_cuda, is_compiled_with_tpu)
+from .core.dtype import (bool_ as bool8, uint8, int8, int16, int32, int64,
+                         float16, bfloat16, float32, float64, complex64,
+                         complex128, set_default_dtype, get_default_dtype,
+                         finfo, iinfo)
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.param_attr import ParamAttr
+
+from .tensor import *  # noqa: F401,F403 — the ~200-op tensor surface
+from .tensor import logic as _logic
+
+grad_enabled = is_grad_enabled
+is_tensor = _logic.is_tensor
+
+from . import tensor  # noqa: E402
+from . import autograd  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import amp  # noqa: E402
+from . import io  # noqa: E402
+from . import jit  # noqa: E402
+from . import distributed  # noqa: E402
+from . import vision  # noqa: E402
+from . import metric  # noqa: E402
+from . import framework  # noqa: E402
+from . import linalg  # noqa: E402
+from . import fft  # noqa: E402
+from . import signal  # noqa: E402
+from . import sparse  # noqa: E402
+from . import profiler  # noqa: E402
+from . import incubate  # noqa: E402
+from . import hapi  # noqa: E402
+from . import device  # noqa: E402
+from . import static  # noqa: E402
+from . import distribution  # noqa: E402
+from . import geometric  # noqa: E402
+from . import utils  # noqa: E402
+
+from .framework.io import save, load  # noqa: E402
+from .autograd.functional import grad  # noqa: E402
+from .hapi.model import Model, summary  # noqa: E402
+from .vision import models  # noqa: E402
+
+DataParallel = distributed.DataParallel

@@ -1,0 +1,231 @@
+"""AMP — mixed precision.
+
+Reference analog: python/paddle/amp/ (auto_cast O1/O2 with per-op white/
+black lists at auto_cast.py:135-149, GradScaler at grad_scaler.py:38; cast
+insertion generated into ad_funcs by eager_gen.py).
+
+TPU-native stance: bf16 is the native mixed-precision dtype and needs NO
+loss scaling; auto_cast with dtype='bfloat16' casts white-list op inputs in
+apply_op (the ad_func hook point). GradScaler is kept for fp16 parity and
+becomes a no-op passthrough when scaling is unnecessary (use_dynamic_loss_
+scaling honored for fp16).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "white_list", "black_list"]
+
+# O1 lists (reference: python/paddle/amp/auto_cast.py:135-149)
+WHITE_LIST = {"matmul", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+              "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+              "einsum", "scaled_dot_product_attention"}
+BLACK_LIST = {"exp", "square", "log", "log2", "log10", "log1p", "mean",
+              "sum", "cos_sim", "softmax", "log_softmax",
+              "softmax_with_cross_entropy", "cross_entropy",
+              "sigmoid_focal_loss", "binary_cross_entropy", "cumsum",
+              "layer_norm", "batch_norm", "rms_norm", "norm", "logsumexp",
+              "erf", "erfinv"}
+
+
+def white_list():
+    return {"float16": {"O1": WHITE_LIST, "O2": WHITE_LIST},
+            "bfloat16": {"O1": WHITE_LIST, "O2": WHITE_LIST}}
+
+
+def black_list():
+    return {"float16": {"O1": BLACK_LIST, "O2": set()},
+            "bfloat16": {"O1": BLACK_LIST, "O2": set()}}
+
+
+from ..core import tensor as _tensor_mod
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_STATE = _AmpState()
+
+
+def amp_state():
+    return _STATE
+
+
+class auto_cast:
+    """Context manager: `with paddle.amp.auto_cast(level='O1'): ...`"""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.custom_white = set(custom_white_list or [])
+        self.custom_black = set(custom_black_list or [])
+
+    def __enter__(self):
+        self._saved = (_STATE.enabled, _STATE.dtype, _STATE.level,
+                       _STATE.custom_white, _STATE.custom_black)
+        _STATE.enabled = self.enable
+        _STATE.dtype = self.dtype
+        _STATE.level = self.level
+        _STATE.custom_white = self.custom_white
+        _STATE.custom_black = self.custom_black
+        return self
+
+    def __exit__(self, *exc):
+        (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.custom_white,
+         _STATE.custom_black) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name, arrays):
+    """Called from apply_op when AMP is on: white-list ops run in low
+    precision, black-list ops in fp32, others follow inputs (promote)."""
+    if not _STATE.enabled:
+        return arrays
+    name = op_name.split(".")[-1]
+    low = _STATE.dtype
+    white = (WHITE_LIST | _STATE.custom_white) - _STATE.custom_black
+    black = (BLACK_LIST | _STATE.custom_black) - _STATE.custom_white
+    if _STATE.level == "O2":
+        if name in black:
+            return [a.astype(jnp.float32)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a
+                    for a in arrays]
+        return [a.astype(low)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays]
+    if name in white:
+        return [a.astype(low)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays]
+    if name in black:
+        return [a.astype(jnp.float32)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays]
+    return arrays
+
+
+_tensor_mod._AMP_CAST_HOOK[0] = amp_cast_inputs
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the low dtype (keeping fp32
+    master weights inside the optimizer accumulators, which are fp32 by
+    construction here)."""
+    if level == "O2":
+        dt = dtype_mod.convert_dtype(dtype)
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: grad_scaler.py:AmpScaler). On TPU
+    with bf16 this is a passthrough; with fp16 it scales and checks
+    found_inf exactly like the reference."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                arr = p.grad._array.astype(jnp.float32) * inv
+                found = found or bool(jnp.any(~jnp.isfinite(arr)))
+                p.grad._set_array(arr)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

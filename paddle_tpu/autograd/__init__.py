@@ -1,0 +1,9 @@
+from .functional import grad, vjp, jvp, jacobian, hessian
+from .pylayer import PyLayer, PyLayerContext
+from .backward_mode import backward
+from ..core.tensor import no_grad, enable_grad, set_grad_enabled, \
+    is_grad_enabled
+
+__all__ = ["grad", "vjp", "jvp", "jacobian", "hessian", "PyLayer",
+           "PyLayerContext", "backward", "no_grad", "enable_grad",
+           "set_grad_enabled", "is_grad_enabled"]

@@ -1,0 +1,192 @@
+"""Functional autograd API.
+
+Reference analog: python/paddle/autograd/ — paddle.grad (GeneralGrad partial
+graphs, paddle/fluid/eager/general_grad.h) and the incubate functional
+jacobian/hessian/vjp/jvp. Here partial-graph grad runs on the same eager
+tape as backward(); jacobian/hessian delegate to jax.jacrev/jax.hessian.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, TapeNode, run_backward, _as_array
+
+
+def _listify(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _replay_pure_fn(outputs, inputs):
+    """Rebuild the tape subgraph from `inputs` to `outputs` as a pure
+    array function (the replacement for the reference's ProgramDesc — the
+    recorded graph replayed functionally; enables higher-order AD and
+    to_static of eager code)."""
+    input_ids = {id(t) for t in inputs}
+    nodes = {}
+    stack = [t._node for t in outputs if t._node is not None]
+    while stack:
+        n = stack.pop()
+        if n is None or n.index in nodes:
+            continue
+        nodes[n.index] = n
+        for inp in n.inputs:
+            if id(inp) not in input_ids and inp._node is not None:
+                stack.append(inp._node)
+    order = sorted(nodes)
+
+    def pure(*arrs):
+        env = {id(t): a for t, a in zip(inputs, arrs)}
+        for idx in order:
+            node = nodes[idx]
+            if node.fwd_fn is None:
+                raise RuntimeError(
+                    f"op '{node.op_name}' does not support replay "
+                    "(create_graph)")
+            in_arrs = [env.get(id(t), t._array) for t in node.inputs]
+            out = node.fwd_fn(*in_arrs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for ref, o in zip(node.out_refs, outs):
+                t = ref()
+                if t is not None:
+                    env[id(t)] = o
+        return tuple(env.get(id(t), t._array) for t in outputs)
+    return pure
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    from ..core.tensor import apply_op
+    pure = _replay_pure_fn(outputs, inputs)
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        seeds.append(jnp.ones_like(t._array) if g is None else _as_array(g))
+
+    def grad_fn(*arrs):
+        _, vjp_fn = jax.vjp(pure, *arrs)
+        return vjp_fn(tuple(seeds))
+    outs = apply_op(grad_fn, *inputs, op_name="grad", n_outs=len(inputs))
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    return list(outs)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """paddle.grad parity: gradients of outputs w.r.t. inputs without
+    touching .grad on other leaves."""
+    outputs = _listify(outputs)
+    inputs = _listify(inputs)
+    grad_outputs = _listify(grad_outputs) or [None] * len(outputs)
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
+
+    # Save and clear .grad of targets, run tape backward, collect, restore.
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    # Temporarily mark no_grad_vars
+    ngv = _listify(no_grad_vars)
+    saved_sg = [(t, t.stop_gradient) for t in ngv]
+    for t in ngv:
+        t.stop_gradient = True
+    try:
+        run_backward(outputs, grad_outputs,
+                     retain_graph=bool(retain_graph) or create_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears unused; "
+                        "pass allow_unused=True to get None for it.")
+                results.append(None)
+            else:
+                g = t.grad
+                g.stop_gradient = not create_graph
+                results.append(g)
+    finally:
+        for t, g in saved:
+            t.grad = g
+        for t, sg in saved_sg:
+            t.stop_gradient = sg
+    return results
+
+
+def _wrap_fn(func):
+    """Adapt a Tensor-level callable to array-level for jax transforms."""
+    def array_fn(*arrays):
+        tensors = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*tensors)
+        if isinstance(out, (list, tuple)):
+            return tuple(_as_array(o) for o in out)
+        return _as_array(out)
+    return array_fn
+
+
+def vjp(func, xs, v=None):
+    xs_list = _listify(xs)
+    arrays = [t._array for t in xs_list]
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *arrays)
+    multi_out = isinstance(out, tuple)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_list = _listify(v)
+        cots = [t._array if isinstance(t, Tensor) else jnp.asarray(t)
+                for t in v_list]
+        cot = tuple(cots) if multi_out else cots[0]
+    grads = vjp_fn(cot)
+    out_t = (tuple(Tensor(o) for o in out) if multi_out else Tensor(out))
+    grads_t = [Tensor(g) for g in grads]
+    return out_t, grads_t if len(grads_t) > 1 else grads_t[0]
+
+
+def jvp(func, xs, v=None):
+    xs_list = _listify(xs)
+    arrays = [t._array for t in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents = [t._array if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in _listify(v)]
+    out, jv = jax.jvp(_wrap_fn(func), tuple(arrays), tuple(tangents))
+    to_t = lambda o: (tuple(Tensor(x) for x in o) if isinstance(o, tuple)
+                      else Tensor(o))
+    return to_t(out), to_t(jv)
+
+
+def jacobian(func, xs, is_batched=False):
+    xs_list = _listify(xs)
+    arrays = [t._array for t in xs_list]
+    jac = jax.jacrev(_wrap_fn(func), argnums=tuple(range(len(arrays))))(*arrays)
+    def conv(j):
+        if isinstance(j, tuple):
+            return tuple(conv(x) for x in j)
+        return Tensor(j)
+    out = conv(jac)
+    if len(arrays) == 1 and isinstance(out, tuple) and len(out) == 1:
+        return out[0]
+    return out
+
+
+def hessian(func, xs, is_batched=False):
+    xs_list = _listify(xs)
+    arrays = [t._array for t in xs_list]
+    hes = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(*arrays)
+    def conv(h):
+        if isinstance(h, tuple):
+            return tuple(conv(x) for x in h)
+        return Tensor(h)
+    out = conv(hes)
+    if len(arrays) == 1 and isinstance(out, tuple) and len(out) == 1:
+        o = out[0]
+        return o[0] if isinstance(o, tuple) and len(o) == 1 else o
+    return out

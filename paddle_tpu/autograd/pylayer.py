@@ -1,0 +1,96 @@
+"""PyLayer — user-defined forward/backward.
+
+Reference analog: paddle/fluid/eager/pylayer/ + python/paddle/autograd/
+py_layer.py. The TPU-native construction records a TapeNode whose vjp is
+the user's static backward(), so PyLayers compose with the eager tape and
+with jit tracing alike (jax.custom_vjp is the purely-functional sibling,
+exposed as `custom_vjp`).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..core.tensor import Tensor, TapeNode, is_grad_enabled, _as_array
+
+import jax
+import jax.numpy as jnp
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # paddle also allows arbitrary attribute stashing — __dict__ covers it.
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(
+            f"{cls.__name__} should not be instantiated; call "
+            f"{cls.__name__}.apply(...) instead.")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        out_list = list(outputs) if multi else [outputs]
+        out_tensors = [o if isinstance(o, Tensor) else Tensor(o)
+                       for o in out_list]
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires = (is_grad_enabled()
+                    and any(not t.stop_gradient for t in tensor_inputs))
+        if requires:
+            def vjp_fn(cot):
+                cots = cot if isinstance(cot, tuple) else (cot,)
+                grads = cls.backward(ctx, *[Tensor(c) for c in cots])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                garrs = []
+                gi = iter(grads)
+                for t in tensor_inputs:
+                    try:
+                        g = next(gi)
+                    except StopIteration:
+                        g = None
+                    garrs.append(jnp.zeros_like(t._array) if g is None
+                                 else _as_array(g))
+                return tuple(garrs)
+
+            for t in out_tensors:
+                t.stop_gradient = False
+            node = TapeNode(vjp_fn, tensor_inputs, out_tensors,
+                            op_name=cls.__name__, multi_out=multi)
+            for t in out_tensors:
+                t._node = node
+        if multi:
+            return tuple(out_tensors)
+        return out_tensors[0]
+
+
+def custom_vjp(fwd=None, bwd=None):
+    """Functional custom-VJP helper over jax.custom_vjp for kernel authors
+    (the fused-op extension point; reference: paddle custom op ABI)."""
+    def deco(fn):
+        cfn = jax.custom_vjp(fn)
+        cfn.defvjp(fwd, bwd)
+        return cfn
+    return deco

@@ -1,0 +1,6 @@
+from . import dtype, place, flags, errors
+from .tensor import (Tensor, to_tensor, apply_op, no_grad, enable_grad,
+                     is_grad_enabled, set_grad_enabled, run_backward)
+from .place import (Place, CPUPlace, TPUPlace, CustomPlace, set_device,
+                    get_device, device_count)
+from .flags import set_flags, get_flags

@@ -1,0 +1,94 @@
+"""Dtype system.
+
+Reference analog: paddle/phi/common/data_type.h (phi::DataType enum) and
+python/paddle/framework/dtype.py. Here dtypes are numpy/jax dtypes directly --
+the TPU-native stance is that jnp dtypes ARE the dtype system; this module
+only adds the paddle-style names and coercion helpers.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype singletons (jnp dtype objects).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [jnp.float32]
+
+
+def convert_dtype(dtype):
+    """Coerce a string / np.dtype / jnp dtype into a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return jnp.dtype(_NAME_TO_DTYPE[dtype])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    d = convert_dtype(d)
+    if d not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16),
+                 jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return jnp.dtype(_DEFAULT_DTYPE[0])
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer) or jnp.dtype(dtype) == jnp.bool_
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(np.dtype(convert_dtype(dtype)))

@@ -1,0 +1,59 @@
+"""Enforce-style error checking.
+
+Reference analog: paddle/phi/core/enforce.h (PADDLE_ENFORCE_* macros with
+typed error categories from paddle/phi/core/errors.h). Python-level because
+the TPU build has no C++ op bodies to guard; jax raises its own errors for
+shape/dtype problems and these helpers add paddle-style categories on top.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    pass
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg="", err_cls=InvalidArgumentError):
+    if not cond:
+        raise err_cls(msg)
+
+
+def enforce_eq(a, b, msg="", err_cls=InvalidArgumentError):
+    if a != b:
+        raise err_cls(f"{msg} (expected {a!r} == {b!r})")
+
+
+def enforce_gt(a, b, msg="", err_cls=InvalidArgumentError):
+    if not a > b:
+        raise err_cls(f"{msg} (expected {a!r} > {b!r})")

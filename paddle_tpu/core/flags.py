@@ -1,0 +1,59 @@
+"""Global flag registry.
+
+Reference analog: the gflags-backed exported-flag system
+(paddle/phi/core/flags.cc, PADDLE_DEFINE_EXPORTED_*) surfaced to Python as
+paddle.set_flags / paddle.get_flags. Flags here are plain Python values with
+env-var (FLAGS_*) initialization, matching the reference's startup parsing
+(paddle/fluid/platform/init.cc).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_str: str = ""):
+    """Register a flag. Env var of the same name overrides the default."""
+    val = default
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            val = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            val = int(env)
+        elif isinstance(default, float):
+            val = float(env)
+        else:
+            val = env
+    _REGISTRY[name] = val
+    return val
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise KeyError(f"Unknown flag {k!r}; registered: {sorted(_REGISTRY)}")
+        _REGISTRY[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _REGISTRY[k] for k in flags}
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# Core flags (subset of the reference's 89 exported flags that are meaningful
+# on the TPU stack; see paddle/phi/core/flags.cc).
+define_flag("FLAGS_check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode.")
+define_flag("FLAGS_benchmark", False, "Synchronize after each op (block_until_ready).")
+define_flag("FLAGS_cudnn_deterministic", False, "Determinism knob (XLA is deterministic by default).")
+define_flag("FLAGS_use_autotune", True, "Enable kernel autotuning where applicable.")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA manages buffers.")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "Kept for API parity; PJRT allocates.")
+define_flag("FLAGS_log_level", 0, "Framework verbose log level (VLOG analog).")

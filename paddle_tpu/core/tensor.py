@@ -1,0 +1,525 @@
+"""Tensor facade + eager autograd tape.
+
+This is the TPU-native answer to three reference subsystems at once:
+
+- the eager Tensor (paddle/fluid/pybind/eager.cc hand-rolled CPython type),
+- the eager autograd engine (paddle/fluid/eager/: GradNodeBase at
+  grad_node_info.h:168, backward engine backward.cc:105/:383,
+  GradNodeAccumulation for leaves, TensorWrapper saved-tensor records),
+- the generated ad_funcs (eager_gen.py) that pair every forward op with its
+  GradNode.
+
+Design: a `Tensor` wraps a jax.Array (or tracer). Every differentiable op
+goes through `apply_op(fn, *inputs)`, which — when gradients are required —
+runs `jax.vjp` on the underlying arrays and records a `TapeNode` holding the
+vjp function and edges to the input tensors. `Tensor.backward()` replays the
+recorded DAG in reverse creation order, accumulating cotangents; leaves
+(stop_gradient=False, no producing node) receive `.grad`, mirroring
+GradNodeAccumulation. Because the tape is plain Python over whatever arrays
+flow through (concrete or traced), the same eager semantics work *inside*
+`jax.jit` traces: a jitted train step may call `loss.backward()` and read
+`param.grad` — the whole DAG flattens into one XLA program, which is the
+TPU-native replacement for the reference's per-op CUDA-stream hot loop
+(SURVEY.md §3.1-3.2).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .place import Place, _current_place
+from .flags import flag
+
+__all__ = [
+    "Tensor", "to_tensor", "apply_op", "no_grad", "enable_grad",
+    "is_grad_enabled", "set_grad_enabled",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_GRAD_STATE = _GradState()
+_NODE_COUNTER = [0]
+
+
+def is_grad_enabled() -> bool:
+    return _GRAD_STATE.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _GRAD_STATE.enabled = bool(mode)
+
+
+class no_grad:
+    """paddle.no_grad parity — context manager & decorator."""
+
+    def __enter__(self):
+        self._prev = _GRAD_STATE.enabled
+        _GRAD_STATE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_STATE.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _GRAD_STATE.enabled
+        _GRAD_STATE.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_STATE.enabled = self._prev
+        return False
+
+
+class TapeNode:
+    """One recorded op: edges to inputs + the vjp closure.
+
+    Reference analog: GradNodeBase (grad_node_info.h:168) — `inputs` are the
+    Edges, `vjp_fn` plays the role of the generated GradNode::operator().
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_refs", "out_avals", "index",
+                 "op_name", "n_outs", "fwd_fn", "multi_out", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, outputs, op_name="", fwd_fn=None,
+                 multi_out=False):
+        self.vjp_fn = vjp_fn
+        self.inputs: List[Tensor] = inputs
+        self.op_name = op_name
+        self.fwd_fn = fwd_fn  # pure array fn for tape replay (higher-order AD)
+        self.multi_out = multi_out  # fwd returned a tuple (even of size 1)
+        self.n_outs = len(outputs)
+        # Weak refs: if an output is dropped by user code, its cotangent is
+        # zeros of the recorded aval (shape/dtype).
+        self.out_refs = [weakref.ref(t) for t in outputs]
+        self.out_avals = [(t._array.shape, t._array.dtype) for t in outputs]
+        _NODE_COUNTER[0] += 1
+        self.index = _NODE_COUNTER[0]
+
+
+class Tensor:
+    """Eager tensor over a jax.Array.
+
+    Attribute parity targets paddle's eager Tensor
+    (pybind/eager_method.cc): .shape/.dtype/.place/.stop_gradient/.grad/
+    .name/.persistable, numpy()/item()/clone()/detach(), backward(),
+    register_hook(), plus operator overloads (math_op_patch.py analog —
+    installed by paddle_tpu.tensor._patch_methods).
+    """
+
+    __slots__ = ("_array", "stop_gradient", "grad", "_node", "name",
+                 "persistable", "_hooks", "trainable", "__weakref__",
+                 "is_leaf_param", "__dict__")
+
+    def __init__(self, array, stop_gradient: bool = True, name: str = ""):
+        if isinstance(array, Tensor):
+            array = array._array
+        self._array = array
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node: Optional[TapeNode] = None
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks: List[Callable] = []
+        self.is_leaf_param = False
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._array.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._array.ndim
+
+    @property
+    def rank(self) -> int:
+        return self._array.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._array.shape)) if self._array.shape else 1
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._array.dtype)
+
+    @property
+    def place(self) -> Place:
+        return _current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def T(self):
+        from ..tensor.linalg import t
+        return t(self)
+
+    @property
+    def mT(self):
+        from ..tensor.manipulation import transpose
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return transpose(self, perm)
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self._array)
+
+    def __len__(self):
+        if not self._array.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._array.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd ----------------------------------------------------------
+    def detach(self) -> "Tensor":
+        t = Tensor(self._array, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        # Differentiable copy (reference: Tensor.clone keeps the graph).
+        return apply_op(lambda x: x + 0, self, op_name="clone")
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        run_backward([self], [grad_tensor], retain_graph)
+
+    def register_hook(self, hook: Callable) -> Callable:
+        """Hook runs on the gradient during backward; returns remover."""
+        self._hooks.append(hook)
+
+        def remove():
+            if hook in self._hooks:
+                self._hooks.remove(hook)
+        return remove
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def _set_array(self, new_array):
+        """In-place value replacement (optimizer updates, .set_value)."""
+        self._array = new_array
+        return self
+
+    def set_value(self, value):
+        arr = value._array if isinstance(value, Tensor) else jnp.asarray(
+            value, dtype=self._array.dtype)
+        return self._set_array(jnp.asarray(arr, dtype=self._array.dtype))
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # -- misc --------------------------------------------------------------
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.asarray(self._array)
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    f"stop_gradient={sg},\n       {data})")
+        except Exception:
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    f"stop_gradient={sg}, traced)")
+
+    def __hash__(self):
+        return id(self)
+
+    # jax pytree protocol — registered below.
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._array,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# op application — the ad_func analog
+# ---------------------------------------------------------------------------
+
+def _as_array(x):
+    return x._array if isinstance(x, Tensor) else x
+
+
+# AMP cast hook — installed by paddle_tpu.amp (avoids a circular import).
+# Plays the role of the "AMP Logic" block eager_gen.py emits into every
+# generated ad_func.
+_AMP_CAST_HOOK = [None]
+
+
+def apply_op(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1,
+             **kwargs):
+    """Run `fn(*arrays, **kwargs)` and record a tape node if needed.
+
+    `fn` must be a jax-traceable function of the positional arrays only;
+    non-Tensor positional args are passed through as constants (closed over
+    for the vjp). Returns Tensor or tuple of Tensors (n_outs>1 or fn returns
+    tuple).
+    """
+    tensor_idx = [i for i, x in enumerate(inputs) if isinstance(x, Tensor)]
+    arrays = [inputs[i]._array for i in tensor_idx]
+    if _AMP_CAST_HOOK[0] is not None:
+        arrays = _AMP_CAST_HOOK[0](op_name, arrays)
+    requires = (is_grad_enabled()
+                and any(not inputs[i].stop_gradient for i in tensor_idx))
+
+    const_inputs = list(inputs)
+
+    def pure_fn(*arrs):
+        full = list(const_inputs)
+        for slot, a in zip(tensor_idx, arrs):
+            full[slot] = a
+        full = [_as_array(x) for x in full]
+        return fn(*full, **kwargs)
+
+    if not requires:
+        out = pure_fn(*arrays)
+        if isinstance(out, (tuple, list)):
+            outs = [Tensor(o, stop_gradient=True) for o in out]
+            _maybe_check_nan_inf(op_name, outs)
+            return tuple(outs)
+        res = Tensor(out, stop_gradient=True)
+        _maybe_check_nan_inf(op_name, (res,))
+        return res
+
+    out, vjp_fn = jax.vjp(pure_fn, *arrays)
+    multi = isinstance(out, (tuple, list))
+    out_list = list(out) if multi else [out]
+    out_tensors = [Tensor(o, stop_gradient=False) for o in out_list]
+    node = TapeNode(vjp_fn, [inputs[i] for i in tensor_idx], out_tensors,
+                    op_name=op_name, fwd_fn=pure_fn, multi_out=multi)
+    for t in out_tensors:
+        t._node = node
+    _maybe_check_nan_inf(op_name, out_tensors)
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
+def tape_snapshot(x: "Tensor") -> "Tensor":
+    """Alias of `x` preserving its current tape node — the pre-mutation
+    view an in-place op must record as its input (TensorWrapper analog).
+    The snapshot takes over x's output slot on its producing node, so
+    cotangents for the pre-mutation value flow to the snapshot while x
+    is free to become the output of the in-place op's node."""
+    s = Tensor(x._array, stop_gradient=x.stop_gradient, name=x.name)
+    s._node = x._node
+    if x._node is not None:
+        x._node.out_refs = [weakref.ref(s) if r() is x else r
+                            for r in x._node.out_refs]
+    return s
+
+
+def rebind_inplace(x: "Tensor", out: "Tensor") -> "Tensor":
+    """Make `x` take over `out`'s value AND its tape node (in-place op
+    support). The op must have been applied to `tape_snapshot(x)`, not `x`
+    itself, so the upstream chain stays reachable through the snapshot.
+    The node's weak out-ref is repointed from the temporary `out` to `x`,
+    so backward credits cotangents accumulated on `x` to the recorded op."""
+    x._set_array(out._array)
+    x.stop_gradient = out.stop_gradient
+    node = out._node
+    if node is not None:
+        for inp in node.inputs:
+            if inp is x:
+                raise RuntimeError(
+                    "rebind_inplace: op recorded the mutated tensor itself "
+                    "as input; apply it to tape_snapshot(x) instead")
+        node.out_refs = [weakref.ref(x) if r() is out else r
+                        for r in node.out_refs]
+    x._node = node
+    return x
+
+
+def _maybe_check_nan_inf(op_name, tensors):
+    """FLAGS_check_nan_inf analog (paddle/fluid/eager/nan_inf_utils.cc)."""
+    if not flag("FLAGS_check_nan_inf"):
+        return
+    for t in tensors:
+        arr = t._array
+        if isinstance(arr, jax.core.Tracer):
+            continue
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            if bool(jnp.any(~jnp.isfinite(arr))):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{op_name}'")
+
+
+# ---------------------------------------------------------------------------
+# backward engine — backward.cc:105 RunBackward analog
+# ---------------------------------------------------------------------------
+
+def run_backward(tensors: Sequence[Tensor],
+                 grad_tensors: Sequence[Optional[Tensor]] = None,
+                 retain_graph: bool = False):
+    grad_tensors = grad_tensors or [None] * len(tensors)
+    # cotangent accumulation keyed by id(tensor); keep tensors alive via map
+    grad_map = {}
+    alive = {}
+
+    def accum(t: Tensor, g):
+        tid = id(t)
+        alive[tid] = t
+        if tid in grad_map:
+            grad_map[tid] = grad_map[tid] + g
+        else:
+            grad_map[tid] = g
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True")
+        if g is None:
+            if any(d > 1 for d in t.shape) and t.size != 1:
+                raise RuntimeError(
+                    "grad_tensor must be provided for non-scalar backward()")
+            g_arr = jnp.ones_like(t._array)
+        else:
+            g_arr = _as_array(g)
+        accum(t, g_arr)
+
+    # Collect reachable nodes (in-degree style traversal of backward.cc:105
+    # replaced by reverse-creation-order processing, which is a valid
+    # topological order because node.index increases monotonically and an
+    # op's inputs are always created before its outputs).
+    nodes = {}
+    stack = [t._node for t in tensors if t._node is not None]
+    while stack:
+        n = stack.pop()
+        if n is None or n.index in nodes:
+            continue
+        nodes[n.index] = n
+        for inp in n.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+
+    for idx in sorted(nodes, reverse=True):
+        node = nodes[idx]
+        cots = []
+        has_any = False
+        for ref, (shape, dt) in zip(node.out_refs, node.out_avals):
+            t = ref()
+            g = grad_map.pop(id(t), None) if t is not None else None
+            if g is None:
+                g = jnp.zeros(shape, dt)
+            else:
+                has_any = True
+                if t is not None and t._hooks:
+                    for hook in t._hooks:
+                        res = hook(Tensor(g))
+                        if res is not None:
+                            g = _as_array(res)
+            cots.append(g)
+        if not has_any:
+            continue
+        cot = tuple(cots) if node.multi_out else cots[0]
+        in_grads = node.vjp_fn(cot)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp.stop_gradient:
+                continue
+            accum(inp, g)
+        if not retain_graph:
+            # free the closure (TensorWrapper release analog)
+            node.vjp_fn = _used_up
+
+    # write leaf grads (GradNodeAccumulation analog)
+    root_ids = {id(t) for t in tensors}
+    for tid, g in grad_map.items():
+        t = alive[tid]
+        if t.stop_gradient:
+            continue
+        if t._node is None or tid in root_ids:
+            for hook in t._hooks:
+                res = hook(Tensor(g))
+                if res is not None:
+                    g = _as_array(res)
+            if t.grad is None:
+                t.grad = Tensor(g)
+            else:
+                t.grad = Tensor(t.grad._array + g)
+
+
+def _used_up(*a, **k):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "call backward(retain_graph=True) if you need to.")
+
+
+# ---------------------------------------------------------------------------
+# to_tensor
+# ---------------------------------------------------------------------------
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        arr = data._array
+        if dtype is not None:
+            arr = arr.astype(dtype_mod.convert_dtype(dtype))
+        t = Tensor(arr, stop_gradient=stop_gradient)
+        return t
+    dt = dtype_mod.convert_dtype(dtype)
+    if isinstance(data, (bool, int, float, complex)) and dt is None:
+        if isinstance(data, bool):
+            dt = jnp.bool_
+        elif isinstance(data, int):
+            dt = jnp.int64
+        elif isinstance(data, float):
+            dt = dtype_mod.get_default_dtype()
+    arr = np.asarray(data)
+    if dt is None and arr.dtype == np.float64:
+        dt = dtype_mod.get_default_dtype()
+    jarr = jnp.asarray(arr, dtype=dt)
+    return Tensor(jarr, stop_gradient=stop_gradient)

@@ -1,0 +1,114 @@
+"""paddle.device parity (reference: python/paddle/device/__init__.py).
+
+cuda submodule maps onto the TPU runtime: streams are XLA-managed; the
+synchronize/memory APIs expose PJRT equivalents.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (set_device, get_device, device_count, CPUPlace,
+                          TPUPlace, CustomPlace, is_compiled_with_cuda,
+                          is_compiled_with_tpu)
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "device_count", "synchronize",
+           "is_compiled_with_cuda", "is_compiled_with_tpu", "cuda", "Stream",
+           "Event"]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def synchronize(device=None):
+    """Block until all queued work completes (cudaDeviceSynchronize
+    analog); XLA exposes this per-array, so sync a trivial computation."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """XLA orders work per-device automatically; Stream is an API-parity
+    no-op handle (reference: paddle/fluid/pybind/cuda_streams_py.cc)."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end):
+        return (end._t - self._t) * 1000.0
+
+
+class _CudaNamespace:
+    """paddle.device.cuda / paddle.cuda parity routed to the TPU chip."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream()
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+        return contextlib.nullcontext()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_reserved", stats.get("bytes_in_use", 0))
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+cuda = _CudaNamespace()

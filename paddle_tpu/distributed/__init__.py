@@ -1,0 +1,34 @@
+"""paddle.distributed parity surface — TPU-native (SURVEY.md §1 L6, §2.3).
+
+NCCL ProcessGroups → named mesh axes; TCPStore/launch →
+jax.distributed.initialize; collective ops → lax collectives under
+shard_map/pjit; fleet 4-D hybrid topology → one jax Mesh.
+"""
+from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
+                         all_gather, all_gather_object, broadcast, reduce,
+                         scatter, alltoall, all_to_all, send, recv,
+                         reduce_scatter, barrier, get_rank, get_world_size,
+                         is_initialized, destroy_process_group, wait, stream)
+from .parallel import (init_parallel_env, ParallelEnv, DataParallel)
+from .mesh import (HybridTopology, init_mesh, get_mesh, set_mesh,
+                   get_topology, ProcessMesh, PartitionSpec, NamedSharding)
+from .shard import (shard_tensor, shard_op, shard_layer,
+                    with_sharding_constraint, shard_params, replicate_params)
+from .random import RNGStatesTracker, get_rng_state_tracker, \
+    model_parallel_random_seed
+from . import fleet
+from . import sharding
+from .launch_utils import spawn, launch
+
+__all__ = [
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+    "all_gather", "all_gather_object", "broadcast", "reduce", "scatter",
+    "alltoall", "all_to_all", "send", "recv", "reduce_scatter", "barrier",
+    "get_rank", "get_world_size", "is_initialized", "destroy_process_group",
+    "wait", "stream", "init_parallel_env", "ParallelEnv", "DataParallel",
+    "HybridTopology", "init_mesh", "get_mesh", "set_mesh", "get_topology",
+    "ProcessMesh", "PartitionSpec", "NamedSharding", "shard_tensor",
+    "shard_op", "shard_layer", "with_sharding_constraint", "shard_params",
+    "replicate_params", "RNGStatesTracker", "get_rng_state_tracker",
+    "model_parallel_random_seed", "fleet", "sharding", "spawn", "launch",
+]

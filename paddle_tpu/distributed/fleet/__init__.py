@@ -1,0 +1,107 @@
+"""Fleet — hybrid-parallel training facade.
+
+Reference analog: python/paddle/distributed/fleet/ (Fleet.init at
+fleet.py:169, _init_hybrid_parallel_env:385 building the 4-D topology,
+distributed_model wrapping in Pipeline/Tensor/Sharding/DataParallel,
+HybridParallelOptimizer).
+
+TPU-native: fleet.init builds the ONE global Mesh from
+DistributedStrategy.hybrid_configs; distributed_model returns the model
+(sharding comes from parameter PartitionSpec annotations + the jit step);
+distributed_optimizer wraps grad-clip with the mesh-aware global-norm.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..mesh import init_mesh, get_topology, HybridTopology
+from ..parallel import init_parallel_env, DataParallel
+from ..collective import get_rank, get_world_size
+from . import mp_layers
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                        RowParallelLinear, ParallelCrossEntropy)
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+            "distributed_optimizer", "get_hybrid_communicate_group",
+            "worker_index", "worker_num", "is_first_worker",
+            "VocabParallelEmbedding", "ColumnParallelLinear",
+            "RowParallelLinear", "ParallelCrossEntropy", "mp_layers"]
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py (protobuf-backed).
+    Keeps the same field names for the knobs that matter on TPU."""
+
+    def __init__(self):
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+
+_FLEET_STATE = {"strategy": None, "topology": None}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    hc = strategy.hybrid_configs
+    topo = init_mesh(dp=hc.get("dp_degree", 1), pp=hc.get("pp_degree", 1),
+                     sharding=hc.get("sharding_degree", 1),
+                     mp=hc.get("mp_degree", 1))
+    _FLEET_STATE["strategy"] = strategy
+    _FLEET_STATE["topology"] = topo
+    return topo
+
+
+def get_hybrid_communicate_group() -> Optional[HybridTopology]:
+    return _FLEET_STATE["topology"] or get_topology()
+
+
+def distributed_model(model):
+    """reference: fleet/model.py:30. On TPU the model is already
+    mesh-ready (parameters carry PartitionSpecs); DP-only models get the
+    DataParallel wrapper for API parity."""
+    topo = get_hybrid_communicate_group()
+    if topo is not None and (topo.mp_degree > 1 or topo.pp_degree > 1):
+        return model
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: HybridParallelOptimizer
+    (dygraph_optimizer/hybrid_parallel_optimizer.py:186). Grad clip is
+    already global under GSPMD (grads are full logical tensors in trace),
+    so the wrapper is the optimizer itself."""
+    return optimizer
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()

@@ -1,0 +1,135 @@
+"""Tensor-parallel (megatron-style) layers.
+
+Reference analog: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding(:35), ColumnParallelLinear(:173),
+RowParallelLinear(:332), ParallelCrossEntropy(:498), with comm primitives
+from mp_ops.py (_c_identity/_c_concat/_c_split/_mp_allreduce).
+
+TPU-native: the layers hold FULL logical weights annotated with
+PartitionSpecs over the 'mp' mesh axis; under jit with the global mesh,
+GSPMD partitions them and inserts the identity/allreduce collectives that
+mp_ops.py implements manually (SURVEY.md §7 capability map). The
+`sharding_spec()` of each parameter is the contract the trainer's pjit
+in/out shardings consume. gather_output/input_is_parallel semantics are
+expressed as output sharding constraints.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ...core.tensor import Tensor, apply_op
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from ...nn import initializer as I
+from ..mesh import get_topology, get_mesh
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "mark_sharding"]
+
+
+def mark_sharding(param: Tensor, spec: PartitionSpec):
+    """Attach the GSPMD annotation; consumed by parallelize_module /
+    shard_params when materializing onto the mesh."""
+    param.sharding_spec = spec
+    return param
+
+
+def _constraint(x: Tensor, spec: PartitionSpec) -> Tensor:
+    """with_sharding_constraint at the Tensor level (traced only)."""
+    def _f(a):
+        if isinstance(a, jax.core.Tracer) and get_mesh() is not None:
+            return jax.lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(get_mesh(), spec))
+        return a
+    return apply_op(_f, x, op_name="sharding_constraint")
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out ('mp'); forward keeps the output
+    sharded (gather_output=False) or constrains it replicated."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in = in_features
+        self._out = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, PartitionSpec(None, "mp"))
+        self.bias = self.create_parameter(
+            [out_features], attr=None if has_bias else False, is_bias=True,
+            default_initializer=I.Constant(0.0)) if has_bias else None
+        if self.bias is not None:
+            mark_sharding(self.bias, PartitionSpec("mp"))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constraint(out, PartitionSpec())
+        return _constraint(out, PartitionSpec(None, None, "mp")
+                           if out.ndim == 3 else PartitionSpec(None, "mp"))
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in ('mp'); partial results are psum'd by
+    GSPMD (the _mp_allreduce analog)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        mark_sharding(self.weight, PartitionSpec("mp", None))
+        self.bias = self.create_parameter(
+            [out_features], attr=None if has_bias else False, is_bias=True,
+            default_initializer=I.Constant(0.0)) if has_bias else None
+        if self.bias is not None:
+            mark_sharding(self.bias, PartitionSpec())
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        return _constraint(out, PartitionSpec())
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded over vocab ('mp'); GSPMD turns the gather
+    into a sharded lookup + psum of masked partials (the reference's
+    c_embedding + allreduce, mp_layers.py:35)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        mark_sharding(self.weight, PartitionSpec("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE (reference mp_layers.py:498 over
+    c_softmax_with_cross_entropy_op). With logits sharded on the class
+    axis, the log-softmax reductions auto-psum over 'mp' under GSPMD; the
+    explicit-collective shard_map variant lives in
+    distributed.parallel_ce for pedagogy/tests."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        logits = _constraint(input, PartitionSpec(None, None, "mp")
+                             if input.ndim == 3
+                             else PartitionSpec(None, "mp"))
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,64 @@
+"""Process launch utilities.
+
+Reference analog: python/paddle/distributed/launch/ (python -m
+paddle.distributed.launch, controllers/collective.py build_pod) and
+paddle.distributed.spawn.
+
+On TPU the unit of launch is one process per HOST (all local chips belong
+to one jax client), so `spawn` with nprocs>1 on one host is only meaningful
+for CPU-mesh testing; `launch` execs the training script once per host with
+coordinator env wired for jax.distributed.initialize.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+
+__all__ = ["spawn", "launch"]
+
+
+def _spawn_target(fn, rank, nprocs, env, args):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs == 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    base_env = {k: v for k, v in os.environ.items()}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_target,
+                        args=(func, rank, nprocs, base_env, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode:
+                raise RuntimeError(
+                    f"spawned rank failed with exit code {p.exitcode}")
+    return procs
+
+
+def launch():
+    """python -m paddle_tpu.distributed.launch <script> parity."""
+    argv = sys.argv[1:]
+    if not argv:
+        print("usage: python -m paddle_tpu.distributed.launch script.py "
+              "[args...]")
+        return 1
+    script = argv[0]
+    sys.argv = argv
+    with open(script) as f:
+        code = compile(f.read(), script, "exec")
+    globs = {"__name__": "__main__", "__file__": script}
+    exec(code, globs)
+    return 0

@@ -1,0 +1,155 @@
+"""Device mesh & hybrid-parallel topology.
+
+Reference analog: fleet's 4-D CommunicateTopology/HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:53/:139) which builds one
+NCCL ring per parallelism axis, and auto_parallel's ProcessMesh
+(python/paddle/distributed/auto_parallel/process_mesh.py:45).
+
+TPU-native: ONE jax.sharding.Mesh whose named axes ARE the process groups —
+["dp", "sharding", "pp", "mp" (tensor), plus optional "sp"/"ep" folded into
+mp/dp]. XLA inserts the collectives over ICI/DCN from PartitionSpec
+annotations; ring ids / groups / streams all disappear.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["HybridTopology", "init_mesh", "get_mesh", "set_mesh",
+           "ProcessMesh", "PartitionSpec", "NamedSharding"]
+
+_GLOBAL_MESH: List[Optional[Mesh]] = [None]
+_GLOBAL_TOPO: List[Optional["HybridTopology"]] = [None]
+
+
+class HybridTopology:
+    """CommunicateTopology analog: axis names + degrees over jax devices.
+
+    order convention matches fleet: outermost "dp" (slowest-varying,
+    cross-host/DCN friendly), innermost "mp" (fastest-varying — TP traffic
+    stays on ICI neighbors), with "pp" and "sharding" in between
+    (reference topology.py uses ["data","pipe","sharding","model"]).
+    """
+
+    AXES = ("dp", "pp", "sharding", "mp")
+
+    def __init__(self, dp=1, pp=1, sharding=1, mp=1, devices=None):
+        devices = devices if devices is not None else jax.devices()
+        want = dp * pp * sharding * mp
+        if want > len(devices):
+            raise ValueError(
+                f"topology {dp}x{pp}x{sharding}x{mp}={want} needs more than "
+                f"{len(devices)} devices")
+        if want < len(devices) and dp == 1 and want == 1:
+            dp = len(devices)  # default pure-DP over all devices
+            want = dp
+        devices = devices[:want]
+        self.dims = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp}
+        dev_array = np.asarray(devices).reshape(dp, pp, sharding, mp)
+        self.mesh = Mesh(dev_array, axis_names=self.AXES)
+
+    # -- fleet-API parity ---------------------------------------------------
+    def get_num_of_ranks(self, axis):
+        return self.dims[axis]
+
+    def world_size(self):
+        return int(np.prod(list(self.dims.values())))
+
+    def get_hybrid_group(self):
+        return self.mesh
+
+    @property
+    def dp_degree(self):
+        return self.dims["dp"]
+
+    @property
+    def pp_degree(self):
+        return self.dims["pp"]
+
+    @property
+    def sharding_degree(self):
+        return self.dims["sharding"]
+
+    @property
+    def mp_degree(self):
+        return self.dims["mp"]
+
+    def spec(self, *axes) -> PartitionSpec:
+        return PartitionSpec(*axes)
+
+    def sharding_for(self, *axes) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*axes))
+
+
+def init_mesh(dp=1, pp=1, sharding=1, mp=1, devices=None) -> HybridTopology:
+    topo = HybridTopology(dp, pp, sharding, mp, devices)
+    _GLOBAL_TOPO[0] = topo
+    _GLOBAL_MESH[0] = topo.mesh
+    return topo
+
+
+def set_mesh(mesh: Mesh):
+    _GLOBAL_MESH[0] = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH[0]
+
+
+def get_topology() -> Optional[HybridTopology]:
+    if _GLOBAL_TOPO[0] is None:
+        init_mesh()
+    return _GLOBAL_TOPO[0]
+
+
+class ProcessMesh:
+    """auto_parallel.ProcessMesh parity: an N-D array of ranks with named
+    dims, convertible to a jax Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        self._rank_array = arr
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def to_jax_mesh(self) -> Mesh:
+        devs = jax.devices()
+        dev_array = np.asarray([devs[r % len(devs)]
+                                for r in self._process_ids]).reshape(
+            self._shape)
+        return Mesh(dev_array, axis_names=tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")

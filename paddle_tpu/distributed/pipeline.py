@@ -1,0 +1,100 @@
+"""Pipeline parallelism — GPipe schedule over the 'pp' mesh axis.
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py:31
+(PipelineParallel.train_batch — 1F1B over NCCL p2p send/recv with
+SendRecvMeta handshakes) and pp_layers.py:209 (PipelineLayer segmenting
+python Layers per stage).
+
+TPU-native: the layer stack is an array axis sharded over 'pp'; the
+schedule is a lax.scan whose per-step stage handoff is ONE lax.ppermute
+over the pp axis inside shard_map — XLA lowers it to ICI neighbor DMA.
+Backward needs no hand-written 1B schedule: jax.grad transposes the scan +
+ppermute into the reverse pipeline automatically (the whole
+p2p_communication.py module collapses into the transpose rule).
+
+Bubble math matches GPipe: T = n_micro + pp - 1 steps, bubble fraction
+(pp-1)/T. Invalid (bubble) steps compute garbage that is masked out of the
+collected outputs — wasted FLOPs equal to the bubble, same as the
+reference's idle stages.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_forward", "pipeline_loss_fn"]
+
+
+def pipeline_forward(cfg, mesh, n_micro, params, ids):
+    """ids -> (hidden_states [B,S,H], aux) with the decoder stack pipelined
+    over 'pp'. Embedding and head stay in the GSPMD (auto) region."""
+    from ..models.llama import _rope_tables, run_layer_stack
+
+    B, S = ids.shape
+    sin, cos = _rope_tables(cfg, S)
+    x = jnp.take(params["embed"], ids, axis=0)         # [B, S, H]
+    assert B % n_micro == 0, (B, n_micro)
+    mb = B // n_micro
+    x_mb = x.reshape(n_micro, mb, S, x.shape[-1])
+    layers = params["layers"]
+
+    def stage_body(layers_local, x_stack, sin_, cos_):
+        n_stages = lax.axis_size("pp")
+        stage = lax.axis_index("pp")
+
+        def step(carry, t):
+            state, outputs, aux = carry
+            idx0 = jnp.clip(t, 0, n_micro - 1)
+            inp = jnp.where(stage == 0, x_stack[idx0], state)
+            out, a = run_layer_stack(cfg, layers_local, inp, sin_, cos_)
+            out_idx = t - (n_stages - 1)
+            valid_out = (stage == n_stages - 1) & (out_idx >= 0)
+            upd = lax.dynamic_update_index_in_dim(
+                outputs, out, jnp.clip(out_idx, 0, n_micro - 1), 0)
+            outputs = jnp.where(valid_out, upd, outputs)
+            valid_compute = (t >= stage) & (t < stage + n_micro)
+            aux = aux + jnp.where(valid_compute, a, 0.0)
+            state = lax.ppermute(
+                out, "pp",
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (state, outputs, aux), None
+
+        carry0 = (jnp.zeros_like(x_stack[0]), jnp.zeros_like(x_stack),
+                  jnp.zeros((), jnp.float32))
+        (state, outputs, aux), _ = lax.scan(
+            step, carry0, jnp.arange(n_micro + n_stages - 1))
+        # replicate the last stage's result across pp (loss/head computed
+        # in the auto region); scalar aux sums contributions of all stages
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), "pp")
+        aux = lax.psum(aux, "pp")
+        return outputs, aux
+
+    layer_manual_specs = jax.tree_util.tree_map(lambda a: P("pp"), layers)
+    outputs, aux = jax.shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(layer_manual_specs, P(), P(), P()),
+        out_specs=(P(), P()),
+        axis_names={"pp"}, check_vma=False)(layers, x_mb, sin, cos)
+    h = outputs.reshape(B, S, x.shape[-1])
+    return h, aux
+
+
+def pipeline_loss_fn(cfg, mesh, n_micro, params, batch):
+    """Full pipelined loss (used by models.llama.build_train_step)."""
+    from ..models.llama import _rms_norm
+
+    ids, labels = batch["input_ids"], batch["labels"]
+    h, aux = pipeline_forward(cfg, mesh, n_micro, params, ids)
+    h = _rms_norm(h, params["norm_f"], cfg.rms_norm_eps)
+    logits = (h @ params["lm_head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    ce = -jnp.mean(ll)
+    return ce + 0.01 * aux, ce

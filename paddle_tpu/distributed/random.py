@@ -1,0 +1,71 @@
+"""Distributed RNG state tracking.
+
+Reference analog: python/paddle/distributed/fleet/layers/mpu/random.py —
+RNGStatesTracker + model_parallel_random_seed: dropout inside TP regions
+must use a per-mp-rank seed, while replicated regions share one.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework.random import Generator
+from .collective import get_rank
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        from ..framework import random as global_rng
+        saved = global_rng.default_generator
+        global_rng.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            global_rng.default_generator = saved
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed or (pyrandom.randint(0, 2 ** 30) + 100)
+    global_seed = seed
+    local_seed = seed + 1024 + get_rank()
+    _TRACKER.reset()
+    from ..framework.random import seed as set_global_seed
+    set_global_seed(global_seed)
+    _TRACKER.add(MODEL_PARALLEL_RNG, local_seed)

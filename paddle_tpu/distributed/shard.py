@@ -1,0 +1,96 @@
+"""Sharding utilities: shard_tensor/shard_op markers + parameter placement.
+
+Reference analog: python/paddle/distributed/auto_parallel/interface.py
+(shard_tensor:28, shard_op:108) and the Engine's partitioner. On TPU the
+"partitioner" is GSPMD: we only annotate; XLA splits.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor, apply_op
+from .mesh import get_mesh, ProcessMesh
+
+__all__ = ["shard_tensor", "shard_op", "shard_layer", "with_sharding_constraint",
+           "shard_params", "replicate_params"]
+
+
+def _to_named_sharding(mesh, spec):
+    m = mesh.to_jax_mesh() if isinstance(mesh, ProcessMesh) else \
+        (mesh or get_mesh())
+    return NamedSharding(m, spec if isinstance(spec, PartitionSpec)
+                         else PartitionSpec(*spec))
+
+
+def shard_tensor(x, mesh=None, placements=None, dist_attr=None):
+    """Place (or annotate, if traced) a tensor on the mesh."""
+    spec = placements if placements is not None else PartitionSpec()
+    ns = _to_named_sharding(mesh, spec)
+    if isinstance(x._array, jax.core.Tracer):
+        def _f(a):
+            return jax.lax.with_sharding_constraint(a, ns)
+        out = apply_op(_f, x, op_name="shard_tensor")
+        return out
+    x._set_array(jax.device_put(x._array, ns))
+    x.sharding_spec = ns.spec
+    return x
+
+
+def with_sharding_constraint(x, spec, mesh=None):
+    ns = _to_named_sharding(mesh, spec)
+
+    def _f(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, ns)
+        return jax.device_put(a, ns)
+    return apply_op(_f, x, op_name="sharding_constraint")
+
+
+def shard_op(op_fn, mesh=None, in_specs=None, out_specs=None):
+    """Constrain an op's outputs (reference interface.py:108)."""
+    def wrapper(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_specs is not None and isinstance(out, Tensor):
+            return with_sharding_constraint(out, out_specs, mesh)
+        return out
+    return wrapper
+
+
+def shard_layer(layer, process_mesh=None, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Apply per-parameter shard_fn (name, param) -> PartitionSpec."""
+    for name, p in layer.named_parameters():
+        spec = shard_fn(name, p) if shard_fn else PartitionSpec()
+        if spec is not None:
+            p.sharding_spec = spec
+    return layer
+
+
+def shard_params(layer, mesh=None):
+    """Materialize every parameter onto the mesh per its sharding_spec
+    annotation (replicated if absent). This is the weight-placement step a
+    trainer runs after fleet.init — the Partitioner analog."""
+    m = mesh or get_mesh()
+    if m is None:
+        return layer
+    for _, p in layer.named_parameters():
+        spec = getattr(p, "sharding_spec", None) or PartitionSpec()
+        p._set_array(jax.device_put(p._array, NamedSharding(m, spec)))
+    for _, b in layer.named_buffers():
+        if b is not None:
+            b._set_array(jax.device_put(b._array,
+                                        NamedSharding(m, PartitionSpec())))
+    return layer
+
+
+def replicate_params(layer, mesh=None):
+    m = mesh or get_mesh()
+    if m is None:
+        return layer
+    ns = NamedSharding(m, PartitionSpec())
+    for _, p in layer.named_parameters():
+        p._set_array(jax.device_put(p._array, ns))
+    return layer

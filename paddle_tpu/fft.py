@@ -1,0 +1,79 @@
+"""paddle.fft parity (reference: python/paddle/fft.py) over jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import apply_op
+from .ops.registry import _ensure_tensor
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _fft1(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        x = _ensure_tensor(x)
+        return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=norm), x,
+                        op_name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+def _fftn(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        x = _ensure_tensor(x)
+        return apply_op(lambda a: jfn(a, s=s, axes=axes, norm=norm), x,
+                        op_name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+fft = _fft1("fft", jnp.fft.fft)
+ifft = _fft1("ifft", jnp.fft.ifft)
+rfft = _fft1("rfft", jnp.fft.rfft)
+irfft = _fft1("irfft", jnp.fft.irfft)
+hfft = _fft1("hfft", jnp.fft.hfft)
+ihfft = _fft1("ihfft", jnp.fft.ihfft)
+fftn = _fftn("fftn", jnp.fft.fftn)
+ifftn = _fftn("ifftn", jnp.fft.ifftn)
+rfftn = _fftn("rfftn", jnp.fft.rfftn)
+irfftn = _fftn("irfftn", jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                    op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                    op_name="ifftshift")

@@ -1,0 +1,3 @@
+from .random import seed, get_rng_state, set_rng_state, Generator, \
+    default_generator
+from .param_attr import ParamAttr

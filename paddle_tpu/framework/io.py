@@ -1,0 +1,66 @@
+"""paddle.save / paddle.load.
+
+Reference analog: python/paddle/framework/io.py:637/:879 — pickled nested
+state_dicts with tensor payloads. Format here: pickle with Tensors converted
+to numpy (+ dtype tag), so checkpoints are host-portable; orbax-backed
+sharded checkpointing for distributed arrays lives in
+distributed.checkpoint.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_BF16_TAG = "__bf16__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._array)
+        if obj._array.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True,
+                    "data": np.asarray(obj._array.astype(jnp.float32))}
+        return arr
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            return Tensor(jnp.asarray(obj["data"]).astype(jnp.bfloat16))
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path))
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

@@ -1,0 +1,69 @@
+"""Global RNG state.
+
+Reference analog: python/paddle/framework/random.py (paddle.seed,
+get/set_cuda_rng_state) over phi Generator (paddle/phi/core/generator.h).
+JAX's RNG is explicitly keyed; this module provides the stateful facade:
+a process-global Generator whose key is split per draw. Distributed RNG
+parity (mpu/random.py RNGStatesTracker) builds on Generator in
+paddle_tpu.distributed.random.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "Generator",
+           "default_generator", "next_key"]
+
+
+class Generator:
+    """Splittable stateful RNG over a jax PRNG key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._counter = 0
+        return self
+
+    def next_key(self):
+        with self._lock:
+            self._counter += 1
+            return jax.random.fold_in(self._key, self._counter)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._key = jax.random.PRNGKey(int(self._seed))
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+
+default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def seed(value: int):
+    """paddle.seed parity — reseeds the global generator."""
+    default_generator.manual_seed(int(value))
+    return default_generator
+
+
+def next_key():
+    return default_generator.next_key()
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

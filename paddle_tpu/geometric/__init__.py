@@ -1,0 +1,100 @@
+"""Graph-NN message passing.
+
+Reference analog: python/paddle/geometric/ (send_u_recv/send_ue_recv/
+segment_* over phi graph_send_recv kernels). TPU-native: jax.ops.segment_sum
+family — XLA lowers to sorted-scatter which tiles well.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+
+def _segment(name, combiner):
+    def op(data, segment_ids, name=None):
+        data, segment_ids = _ensure_tensor(data), _ensure_tensor(segment_ids)
+        num = int(jnp.max(segment_ids._array)) + 1 \
+            if segment_ids._array.size else 0
+
+        def _f(d, s):
+            return combiner(d, s.astype(jnp.int32), num)
+        return apply_op(_f, data, segment_ids, op_name=op.__name__)
+    op.__name__ = name
+    register(name, op)
+    return op
+
+
+segment_sum = _segment(
+    "segment_sum",
+    lambda d, s, n: jax.ops.segment_sum(d, s, num_segments=n))
+segment_mean = _segment(
+    "segment_mean",
+    lambda d, s, n: jax.ops.segment_sum(d, s, num_segments=n)
+    / jnp.maximum(jax.ops.segment_sum(jnp.ones_like(d), s, num_segments=n),
+                  1))
+segment_max = _segment(
+    "segment_max",
+    lambda d, s, n: jax.ops.segment_max(d, s, num_segments=n))
+segment_min = _segment(
+    "segment_min",
+    lambda d, s, n: jax.ops.segment_min(d, s, num_segments=n))
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled specially
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    x = _ensure_tensor(x)
+    src_index = _ensure_tensor(src_index)
+    dst_index = _ensure_tensor(dst_index)
+    n_out = out_size or x.shape[0]
+
+    def _f(xa, si, di):
+        msgs = jnp.take(xa, si.astype(jnp.int32), axis=0)
+        di = di.astype(jnp.int32)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, di, num_segments=n_out)
+            c = jax.ops.segment_sum(jnp.ones_like(msgs), di,
+                                    num_segments=n_out)
+            return s / jnp.maximum(c, 1)
+        return _REDUCERS[reduce_op](msgs, di, num_segments=n_out)
+    return apply_op(_f, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    src_index = _ensure_tensor(src_index)
+    dst_index = _ensure_tensor(dst_index)
+    n_out = out_size or x.shape[0]
+
+    def _f(xa, ya, si, di):
+        msgs = jnp.take(xa, si.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            msgs = msgs + ya
+        elif message_op == "mul":
+            msgs = msgs * ya
+        elif message_op == "sub":
+            msgs = msgs - ya
+        elif message_op == "div":
+            msgs = msgs / ya
+        di = di.astype(jnp.int32)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, di, num_segments=n_out)
+            c = jax.ops.segment_sum(jnp.ones_like(msgs), di,
+                                    num_segments=n_out)
+            return s / jnp.maximum(c, 1)
+        return _REDUCERS[reduce_op](msgs, di, num_segments=n_out)
+    return apply_op(_f, x, y, src_index, dst_index, op_name="send_ue_recv")

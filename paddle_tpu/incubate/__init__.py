@@ -1,0 +1,3 @@
+"""paddle.incubate parity: fused nn ops, autograd extras, MoE."""
+from . import nn
+from . import autograd

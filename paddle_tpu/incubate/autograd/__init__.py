@@ -1,0 +1,19 @@
+"""incubate.autograd parity (reference: python/paddle/incubate/autograd/):
+functional jacobian/hessian/vjp/jvp re-exports + forward-prim toggles."""
+from ...autograd.functional import jacobian, hessian, vjp, jvp
+
+_PRIM_ENABLED = [False]
+
+
+def enable_prim():
+    # jax IS a primitive-based AD system; the toggle is a no-op kept for
+    # API parity with primapi.py.
+    _PRIM_ENABLED[0] = True
+
+
+def disable_prim():
+    _PRIM_ENABLED[0] = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED[0]

@@ -1,0 +1,11 @@
+"""Fused-layer surface (reference: python/paddle/incubate/nn/ over the
+operators/fused/ CUDA corpus — fused_attention_op.cu,
+fused_multi_transformer_op.cu, fused_feedforward).
+
+TPU-native: "fused" means a single jitted composition XLA fuses, with the
+flash-attention Pallas kernel swapped in for the attention core when
+shapes qualify.
+"""
+from . import functional
+from .fused_transformer import (FusedMultiHeadAttention, FusedFeedForward,
+                                FusedTransformerEncoderLayer)

@@ -1,0 +1,161 @@
+"""Fused functionals (reference: python/paddle/incubate/nn/functional/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply_op
+from ...ops.registry import _ensure_tensor
+from ...nn.functional.common import scaled_dot_product_attention
+
+__all__ = ["fused_matmul_bias", "fused_linear", "fused_feedforward",
+           "fused_multi_head_attention", "fused_dropout_add",
+           "fused_rotary_position_embedding", "swiglu"]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    args = [x, y]
+    if bias is not None:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, b, *bias_):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b)
+        if bias_:
+            out = out + bias_[0]
+        return out
+    return apply_op(_f, *args, op_name="fused_matmul_bias")
+
+
+fused_linear = fused_matmul_bias
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU activation (Llama MLP): silu(x) * y, or split-in-half form."""
+    x = _ensure_tensor(x)
+    if y is None:
+        def _f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return apply_op(_f, x, op_name="swiglu")
+    y = _ensure_tensor(y)
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y, op_name="swiglu")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ...framework.random import next_key
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    if not training or p == 0:
+        return apply_op(jnp.add, x, y, op_name="fused_dropout_add")
+    key = next_key()
+
+    def _f(a, b):
+        keep = jax.random.bernoulli(key, 1 - p, a.shape)
+        dropped = jnp.where(keep, a / (1 - p), 0.0).astype(a.dtype)
+        return dropped + b
+    return apply_op(_f, x, y, op_name="fused_dropout_add")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    name=None):
+    """RoPE applied to q/k (reference: later-paddle fused op; first-class
+    here for the Llama configs)."""
+    def rope(t, sin_a, cos_a):
+        if use_neox_rotary_style:
+            half = t.shape[-1] // 2
+            t1, t2 = t[..., :half], t[..., half:]
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., ::2]
+            t2 = t[..., 1::2]
+            rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos_a + rot * sin_a
+
+    outs = []
+    sin_a = sin._array if sin is not None else None
+    cos_a = cos._array if cos is not None else None
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        tt = _ensure_tensor(t)
+        if t is v:
+            outs.append(tt)
+            continue
+        outs.append(apply_op(lambda a: rope(a, sin_a, cos_a), tt,
+                             op_name="rope"))
+    return tuple(outs)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-05,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-05,
+                               training=True, mode='upscale_in_train',
+                               ring_id=-1, num_heads=None, name=None):
+    """Monolithic fused attention (reference: fused_attention_op.cu).
+    qkv_weight: [3, n_heads, head_dim, embed_dim]."""
+    from ...nn import functional as F
+    x = _ensure_tensor(x)
+    qkv_w = _ensure_tensor(qkv_weight)
+    lin_w = _ensure_tensor(linear_weight)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    three, n_heads, head_dim, embed_dim = qkv_w.shape
+
+    def qkv_proj(a, w):
+        out = jnp.einsum("bse,thde->bsthd", a, w)
+        return out
+    qkv = apply_op(qkv_proj, x, qkv_w, op_name="qkv_proj")
+    if qkv_bias is not None:
+        qkv = qkv + _ensure_tensor(qkv_bias)
+    from ...tensor.manipulation import unstack
+    q, k, v = unstack(qkv, axis=2)
+    out = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                       dropout_p=attn_dropout_rate
+                                       if training else 0.0,
+                                       training=training)
+    b, s = out.shape[0], out.shape[1]
+    from ...tensor.manipulation import reshape
+    out = reshape(out, [b, s, n_heads * head_dim])
+    out = F.linear(out, lin_w, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training)
+    out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode='upscale_in_train',
+                      ring_id=-1, name=None):
+    from ...nn import functional as F
+    x = _ensure_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+    out = F.linear(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training)
+    out = F.linear(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training)
+    out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out

@@ -1,0 +1,25 @@
+"""Data pipeline.
+
+Reference analog: paddle.io (python/paddle/fluid/reader.py:311 DataLoader,
+fluid/dataloader/: Dataset/IterableDataset/BatchSampler/worker processes +
+shared-memory transport over a C++ blocking queue in operators/reader/).
+
+TPU-native: the multiprocess worker pool feeds a prefetch queue of numpy
+batches; `DataLoader(..., return_list=True)` yields Tensors. Device
+transfer happens lazily on first op (jax.device_put under the hood), and
+double-buffering to the chip is handled by the trainer utilities
+(hapi.Model / distributed shard loaders) rather than per-loader threads.
+"""
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, Subset, ConcatDataset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler, SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "Subset", "ConcatDataset", "random_split",
+           "Sampler", "SequenceSampler", "RandomSampler",
+           "WeightedRandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "SubsetRandomSampler", "DataLoader",
+           "default_collate_fn", "get_worker_info"]

@@ -1,0 +1,186 @@
+"""DataLoader.
+
+Reference analog: python/paddle/fluid/reader.py:311 (DataLoader) +
+dataloader_iter.py:162/:370 (single/multi-process iterators with worker
+processes and shared-memory LoDTensor transport over a C++ blocking queue).
+
+TPU-native: workers are multiprocessing processes producing numpy batches
+into an mp.Queue (kernel shared memory transport); a prefetch thread keeps
+`prefetch_factor` batches decoded ahead. Batches convert to Tensors on
+yield; XLA transfers them on first use.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as queue_mod
+import threading
+import multiprocessing as mp
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._array) for s in batch])
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(t)) for t in transposed)
+    return batch
+
+
+def _to_tensor_tree(data):
+    if isinstance(data, np.ndarray):
+        return to_tensor(data)
+    if isinstance(data, dict):
+        return {k: _to_tensor_tree(v) for k, v in data.items()}
+    if isinstance(data, (list, tuple)):
+        return type(data)(_to_tensor_tree(v) for v in data)
+    return data
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 num_workers, base_seed):
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset,
+                                   base_seed + worker_id)
+    np.random.seed(base_seed + worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data_queue.put((batch_id, collate_fn(samples), None))
+        except Exception as e:  # propagate worker errors
+            data_queue.put((batch_id, None, e))
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._is_iterable = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not self._is_iterable:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+            self.batch_size = batch_size
+        else:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self._is_iterable:
+            raise TypeError("IterableDataset has no fixed length")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._is_iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multi()
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield _to_tensor_tree(self.collate_fn(batch))
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield _to_tensor_tree(self.collate_fn(batch))
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield _to_tensor_tree(self.collate_fn(samples))
+
+    def _iter_multi(self):
+        ctx = mp.get_context("fork")
+        index_queues = []
+        data_queue = ctx.Queue()
+        workers = []
+        base_seed = np.random.randint(0, 2 ** 31 - 1)
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, data_queue, self.collate_fn, wid,
+                      self.num_workers, base_seed),
+                daemon=True)
+            w.start()
+            workers.append(w)
+            index_queues.append(iq)
+        try:
+            batches = list(self.batch_sampler)
+            # dispatch round-robin with bounded in-flight count
+            inflight = 0
+            next_dispatch = 0
+            reorder = {}
+            next_yield = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            while next_yield < len(batches):
+                while next_dispatch < len(batches) and inflight < max_inflight:
+                    index_queues[next_dispatch % self.num_workers].put(
+                        (next_dispatch, batches[next_dispatch]))
+                    next_dispatch += 1
+                    inflight += 1
+                bid, data, err = data_queue.get(
+                    timeout=self.timeout if self.timeout else None)
+                if err is not None:
+                    raise err
+                inflight -= 1
+                reorder[bid] = data
+                while next_yield in reorder:
+                    yield _to_tensor_tree(reorder.pop(next_yield))
+                    next_yield += 1
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

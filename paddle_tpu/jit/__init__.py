@@ -1,0 +1,18 @@
+"""JIT: graph capture and AOT export.
+
+Reference analog: python/paddle/jit/ — @to_static (api.py:222) AST-rewrites
+python control flow into ProgramDesc ops and caches ConcreteProgram per
+InputSpec (program_translator.py:283/:1225); jit.save emits .pdmodel.
+
+TPU-native: `to_static` IS `jax.jit` over the Tensor facade — tracing the
+eager tape through XLA replaces the AST transformer + ProgramDesc +
+InterpreterCore stack (SURVEY.md §3.3/§3.5). The per-input-spec cache
+maps onto jax's compilation cache keyed by abstract shapes/dtypes.
+`jit.save` exports StableHLO via jax.export plus a state_dict payload;
+`jit.load` restores a callable.
+"""
+from .api import to_static, not_to_static, ignore_module, TracedLayer, \
+    save, load, InputSpec
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
+           "InputSpec", "TracedLayer"]

@@ -1,0 +1,206 @@
+"""to_static / save / load implementation."""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, _as_array
+from ..core import dtype as dtype_mod
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype.name})"
+
+
+def _tree_to_arrays(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._array if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _tree_to_tensors(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if isinstance(x, jax.Array) else x, tree)
+
+
+class StaticFunction:
+    """Traced-and-compiled callable with per-signature cache.
+
+    The eager tape runs under jax tracing, so arbitrary Layer forward code
+    (including loss.backward() + optimizer.step() on the facade) compiles
+    into a single XLA program. Mutated state (parameters, buffers, RNG) must
+    be functionalized by the caller or via the `mutates` hook used by
+    hapi.Model.
+    """
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None, donate_argnums=()):
+        self._fn = fn
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn)
+
+        def array_fn(*arrays, **kw):
+            tensors = _tree_to_tensors(arrays)
+            out = fn(*tensors, **kw)
+            return _tree_to_arrays(out)
+        self._jitted = jax.jit(array_fn, donate_argnums=donate_argnums)
+
+    def __call__(self, *args, **kwargs):
+        arrays = _tree_to_arrays(args)
+        out = self._jitted(*arrays, **kwargs)
+        return _tree_to_tensors(out)
+
+    @property
+    def concrete_program(self):
+        return self._jitted
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity (reference: jit/api.py:222)."""
+
+    def decorate(fn_or_layer):
+        from ..nn.layer.layers import Layer
+        if isinstance(fn_or_layer, Layer):
+            layer = fn_or_layer
+            layer.forward = StaticFunction(layer.forward, input_spec)
+            return layer
+        return StaticFunction(fn_or_layer, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TracedLayer:
+    """Legacy dygraph-trace API (reference: fluid/dygraph/jit.py)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf = StaticFunction(layer.forward)
+        outs = layer(*inputs)
+        return outs, TracedLayer(sf)
+
+    def __call__(self, inputs):
+        return self._fn(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# jit.save / jit.load — AOT export via StableHLO + weights payload
+# ---------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer (or StaticFunction) for serving.
+
+    Produces:
+      path + '.pdiparams'  — pickled state_dict (numpy payloads)
+      path + '.pdmodel'    — StableHLO module text from jax.export (the
+                             ProgramDesc analog; reference jit/api.py:773)
+      path + '.meta'       — input specs + structure info
+    """
+    from ..nn.layer.layers import Layer
+    from ..framework.io import save as fsave
+
+    if isinstance(layer, Layer):
+        forward = layer.forward
+        state = layer.state_dict()
+        layer.eval()
+
+        params = {k: v._array for k, v in state.items()}
+
+        if input_spec is None:
+            raise ValueError("jit.save requires input_spec for AOT export")
+
+        specs = [s if isinstance(s, InputSpec) else InputSpec(**s)
+                 for s in input_spec]
+        abstract = [jax.ShapeDtypeStruct(
+            [1 if d in (-1, None) else d for d in s.shape], s.dtype)
+            for s in specs]
+
+        def pure_forward(params_in, *xs):
+            sd = layer.state_dict()
+            saved = {k: v._array for k, v in sd.items()}
+            try:
+                for k, arr in params_in.items():
+                    sd[k]._set_array(arr)
+                out = layer(*[Tensor(x) for x in xs])
+                return _tree_to_arrays(out)
+            finally:
+                for k, arr in saved.items():
+                    sd[k]._set_array(arr)
+
+        from jax import export as jexport
+        exported = jexport.export(jax.jit(pure_forward))(
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in params.items()}, *abstract)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        fsave({k: Tensor(v) for k, v in params.items()},
+              path + ".pdiparams")
+        with open(path + ".meta", "wb") as f:
+            pickle.dump({"input_specs": [(s.shape, s.dtype.name)
+                                         for s in specs]}, f)
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+class TranslatedLayer:
+    """Loaded serving artifact (reference: jit/translated_layer.py)."""
+
+    def __init__(self, exported, params):
+        self._exported = exported
+        self._params = params
+
+    def __call__(self, *args):
+        arrays = [_as_array(a) for a in args]
+        out = self._exported.call(self._params, *arrays)
+        return _tree_to_tensors(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return {k: Tensor(v) for k, v in self._params.items()}
+
+
+def load(path, **configs):
+    from jax import export as jexport
+    from ..framework.io import load as fload
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    params_t = fload(path + ".pdiparams")
+    params = {k: v._array for k, v in params_t.items()}
+    return TranslatedLayer(exported, params)

@@ -1,0 +1,8 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py)."""
+from .tensor.linalg import (matmul, bmm, dot, mv, t, norm, dist, cond, cross,
+                            cholesky, cholesky_solve, qr, svd, inv, det,
+                            slogdet, solve, triangular_solve, eig, eigh,
+                            eigvals, eigvalsh, matrix_power, matrix_rank,
+                            pinv, lstsq, lu, multi_dot, corrcoef, cov,
+                            householder_product)
+from .tensor.math import trace

@@ -1,0 +1,466 @@
+"""Llama model family — the flagship LLM config (BASELINE.json #4).
+
+Two faces:
+
+1. `LlamaForCausalLM` — an eager `nn.Layer` built from the TP layer library
+   (fleet mp_layers), usable like the reference PaddleNLP model: forward,
+   loss, generate-one-step. Capability parity surface.
+
+2. The functional core (`init_params` / `forward_pure` /
+   `build_train_step`) — pure jnp functions over a stacked-parameter
+   pytree, which is what the 4-D+ parallel trainer, the pipeline schedule,
+   `__graft_entry__.dryrun_multichip` and `bench.py` drive. This is the
+   TPU-native replacement for fleet's PipelineLayer/LayerDesc partitioning
+   (reference: fleet/meta_parallel/parallel_layers/pp_layers.py:209) —
+   layers are stacked along a leading axis and sharded/scanned rather than
+   partitioned into per-rank Python objects.
+
+Parallelism mapping (SURVEY.md §7):
+  dp      — batch axis sharding (+ ZeRO: optimizer state sharded on dp)
+  mp (tp) — megatron column/row specs on attention + MLP weights; vocab-
+            parallel embedding & lm_head; sequence-parallel activations
+            ride the same axis between blocks
+  pp      — layer-stack axis sharded over 'pp'; GPipe/1F1B microbatch
+            schedule via shard_map + ppermute (distributed/pipeline.py)
+  ep      — MoE expert axis sharded over 'dp' (GShard-style dense dispatch,
+            reference analog: incubate/distributed/models/moe/moe_layer.py)
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "init_params", "forward_pure",
+           "build_train_step", "param_specs"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    # MoE (config #5 — DeepSeekMoE/Qwen-MoE shape)
+    moe_num_experts: int = 0          # 0 => dense FFN
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # training
+    use_remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _split_key(key, n):
+    return list(jax.random.split(key, n))
+
+
+def init_params(cfg: LlamaConfig, key) -> Dict[str, Any]:
+    """Stacked parameter pytree. Layer axis L leads every per-layer array."""
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    V = cfg.vocab_size
+    KV = cfg.num_key_value_heads * cfg.head_dim
+    k = iter(_split_key(key, 16))
+    std = 0.02
+
+    def init(k_, shape):
+        return (jax.random.normal(k_, shape, jnp.float32) * std).astype(
+            cfg.dtype)
+
+    params = {
+        "embed": init(next(k), (V, H)),
+        "layers": {
+            "ln1": jnp.ones((L, H), cfg.dtype),
+            "wq": init(next(k), (L, H, H)),
+            "wk": init(next(k), (L, H, KV)),
+            "wv": init(next(k), (L, H, KV)),
+            "wo": init(next(k), (L, H, H)),
+            "ln2": jnp.ones((L, H), cfg.dtype),
+        },
+        "norm_f": jnp.ones((H,), cfg.dtype),
+        "lm_head": init(next(k), (H, V)),
+    }
+    if cfg.moe_num_experts > 0:
+        E = cfg.moe_num_experts
+        params["layers"]["router"] = init(next(k), (L, H, E)).astype(
+            jnp.float32)
+        params["layers"]["w_gate"] = init(next(k), (L, E, H, I))
+        params["layers"]["w_up"] = init(next(k), (L, E, H, I))
+        params["layers"]["w_down"] = init(next(k), (L, E, I, H))
+    else:
+        params["layers"]["w_gate"] = init(next(k), (L, H, I))
+        params["layers"]["w_up"] = init(next(k), (L, H, I))
+        params["layers"]["w_down"] = init(next(k), (L, I, H))
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """GSPMD PartitionSpecs — the Column/RowParallel + vocab-parallel and
+    expert-parallel placement contract (mp_layers.py analog). Leading layer
+    axis is sharded over 'pp' (the pipeline placement)."""
+    moe = cfg.moe_num_experts > 0
+    layers = {
+        "ln1": P("pp", None),
+        "wq": P("pp", None, "mp"),     # column parallel
+        "wk": P("pp", None, "mp"),
+        "wv": P("pp", None, "mp"),
+        "wo": P("pp", "mp", None),     # row parallel
+        "ln2": P("pp", None),
+    }
+    if moe:
+        layers.update({
+            "router": P("pp", None, None),
+            "w_gate": P("pp", "dp", None, "mp"),   # experts over dp (=ep)
+            "w_up": P("pp", "dp", None, "mp"),
+            "w_down": P("pp", "dp", "mp", None),
+        })
+    else:
+        layers.update({
+            "w_gate": P("pp", None, "mp"),
+            "w_up": P("pp", None, "mp"),
+            "w_down": P("pp", "mp", None),
+        })
+    return {
+        "embed": P("mp", None),        # vocab parallel
+        "layers": layers,
+        "norm_f": P(None),
+        "lm_head": P(None, "mp"),      # column parallel (vocab out)
+    }
+
+
+# ---------------------------------------------------------------------------
+# pure forward pieces
+# ---------------------------------------------------------------------------
+
+def _rope_tables(cfg: LlamaConfig, seq_len: int):
+    half = cfg.head_dim // 2
+    inv_freq = 1.0 / (cfg.rope_theta
+                      ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                      # [S, half]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)      # [S, D]
+    return jnp.sin(emb), jnp.cos(emb)
+
+
+def _apply_rope(x, sin, cos):
+    # x: [B, S, H, D] (neox style)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    sin_ = sin[None, :, None, :].astype(x.dtype)
+    cos_ = cos[None, :, None, :].astype(x.dtype)
+    return x * cos_ + rot * sin_
+
+
+def _rms_norm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(ms + eps)).astype(x.dtype) * w
+
+
+def _attention(cfg: LlamaConfig, lp, x, sin, cos):
+    B, S, H = x.shape
+    nh, nkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, \
+        cfg.head_dim
+    q = (x @ lp["wq"]).reshape(B, S, nh, d)
+    k = (x @ lp["wk"]).reshape(B, S, nkv, d)
+    v = (x @ lp["wv"]).reshape(B, S, nkv, d)
+    q = _apply_rope(q, sin, cos)
+    k = _apply_rope(k, sin, cos)
+    if nkv != nh:  # grouped-query attention: repeat kv heads
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # flash-attention via Pallas when available; jnp fallback (XLA fuses)
+    from ..ops import pallas_ops
+    out = pallas_ops.causal_attention(q, k, v)
+    return out.reshape(B, S, H) @ lp["wo"]
+
+
+def _dense_mlp(lp, x):
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    up = x @ lp["w_up"]
+    return (gate * up) @ lp["w_down"]
+
+
+def _moe_mlp(cfg: LlamaConfig, lp, x):
+    """GShard top-k MoE with capacity, dense dispatch einsums.
+
+    Reference analog: moe_layer.py:260 MoELayer + global_scatter/gather
+    NCCL all-to-all. Here dispatch/combine are einsums against a one-hot
+    capacity tensor; with the expert axis of w_* sharded over 'dp', GSPMD
+    lowers the token<->expert resharding to the same all-to-all over ICI.
+    """
+    B, S, H = x.shape
+    E, K = cfg.moe_num_experts, cfg.moe_top_k
+    T = B * S
+    C = max(1, int(cfg.moe_capacity_factor * T * K / E))
+    xt = x.reshape(T, H)
+    logits = (xt.astype(jnp.float32) @ lp["router"])        # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, K)               # [T, K]
+    gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+    # position of each (t, k) within its expert's capacity buffer
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)   # [T, K, E]
+    flat = onehot.reshape(T * K, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(T, K, E)
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)          # [T, K]
+    keep = pos < C
+    # dispatch tensor [T, K, E, C]
+    disp = (onehot.astype(jnp.bool_)
+            & keep[..., None]).astype(x.dtype)[..., None] \
+        * jax.nn.one_hot(jnp.where(keep, pos, 0), C, dtype=x.dtype)[
+            :, :, None, :]
+    combine = disp * gate_vals[..., None, None].astype(x.dtype)
+    disp2 = disp.sum(1)                                     # [T, E, C]
+    expert_in = jnp.einsum("tec,th->ech", disp2, xt)        # [E, C, H]
+    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", expert_in, lp["w_gate"]))
+    up = jnp.einsum("ech,ehi->eci", expert_in, lp["w_up"])
+    expert_out = jnp.einsum("eci,eih->ech", gate * up, lp["w_down"])
+    out = jnp.einsum("tkec,ech->th", combine, expert_out)
+    # aux load-balancing loss (GShard)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32),
+                  axis=0)
+    aux = E * jnp.sum(me * ce)
+    return out.reshape(B, S, H), aux
+
+
+def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos):
+    """One decoder block on a per-layer param slice (no leading L axis)."""
+    h = x + _attention(cfg, lp, _rms_norm(x, lp["ln1"], cfg.rms_norm_eps),
+                       sin, cos)
+    normed = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+    if cfg.moe_num_experts > 0:
+        mlp_out, aux = _moe_mlp(cfg, lp, normed)
+        return h + mlp_out, aux
+    return h + _dense_mlp(lp, normed), jnp.zeros((), jnp.float32)
+
+
+def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos):
+    """lax.scan over the stacked layer axis (compiler-friendly sequential
+    control flow; remat per layer = the recompute strategy)."""
+    def body(carry, lp):
+        h, aux = carry
+        fn = decoder_layer
+        if cfg.use_remat:
+            fn = jax.checkpoint(decoder_layer, static_argnums=(0,))
+        h, a = fn(cfg, lp, h, sin, cos)
+        return (h, aux + a), None
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), stacked)
+    return x, aux
+
+
+def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None):
+    """Full forward: ids -> logits (fp32). sp_axis: mesh axis name to shard
+    the sequence dimension of activations on (sequence parallelism)."""
+    B, S = input_ids.shape
+    sin, cos = _rope_tables(cfg, S)
+    x = jnp.take(params["embed"], input_ids, axis=0)
+    if sp_axis is not None:
+        x = lax.with_sharding_constraint(x, P("dp", sp_axis, None))
+    x, aux = run_layer_stack(cfg, params["layers"], x, sin, cos)
+    x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, aux
+
+
+def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None):
+    ids, labels = batch["input_ids"], batch["labels"]
+    logits, aux = forward_pure(cfg, params, ids, sp_axis)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    ce = -jnp.mean(ll)
+    return ce + 0.01 * aux, ce
+
+
+# ---------------------------------------------------------------------------
+# parallel train step
+# ---------------------------------------------------------------------------
+
+def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
+                     n_microbatches=None, zero=True):
+    """Compiled full training step over the hybrid mesh.
+
+    Returns (step_fn, init_fn):
+      init_fn(rng) -> (params, opt_state) placed per param_specs (+ZeRO
+      opt-state sharding over 'dp').
+      step_fn(params, opt_state, batch) -> (params, opt_state, metrics).
+
+    use_pp: pipeline over the 'pp' axis with shard_map (GPipe schedule);
+    defaults to pp_degree > 1.
+    """
+    import optax
+    mesh = topo.mesh
+    pp = topo.pp_degree
+    use_pp = (pp > 1) if use_pp is None else use_pp
+    opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    specs = param_specs(cfg)
+
+    if use_pp:
+        from ..distributed.pipeline import pipeline_loss_fn
+        loss = functools.partial(pipeline_loss_fn, cfg, mesh,
+                                 n_microbatches or pp)
+    else:
+        def loss(params, batch):
+            return loss_fn(cfg, params, batch, sp_axis="mp")
+
+    def sharding_tree(tree_specs):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), tree_specs,
+            is_leaf=lambda s: isinstance(s, P))
+
+    param_sh = sharding_tree(specs)
+
+    def zero_shard_spec(spec, shape):
+        # ZeRO-1: shard the largest unsharded dim of each optimizer-state
+        # array over 'dp' when divisible (distributed/sharding rationale)
+        dims = list(spec) + [None] * (len(shape) - len(spec))
+        if not zero or "dp" in dims or not shape:
+            return P(*dims) if dims else P()
+        n = topo.dp_degree
+        for i, d in sorted(enumerate(shape), key=lambda t: -t[1]):
+            if dims[i] is None and d % n == 0 and d >= n:
+                dims[i] = "dp"
+                break
+        return P(*dims)
+
+    def init_fn(rng):
+        with mesh:
+            params = jax.jit(
+                lambda k: init_params(cfg, k),
+                out_shardings=param_sh)(rng)
+            opt_state = jax.jit(
+                opt.init,
+                out_shardings=None)(params)
+            # re-place opt state with ZeRO sharding
+            def place(x, pspec):
+                if hasattr(x, "shape") and x.ndim > 0:
+                    return jax.device_put(
+                        x, NamedSharding(mesh, zero_shard_spec(
+                            pspec, x.shape)))
+                return x
+
+            def spec_of(x, path_spec):
+                return path_spec
+
+            # map each opt-state leaf to the spec of its matching param if
+            # shapes align, else replicate
+            flat_params, tdef = jax.tree_util.tree_flatten(params)
+            shapes = {p.shape: s for p, s in zip(
+                flat_params, jax.tree_util.tree_leaves(
+                    specs, is_leaf=lambda s: isinstance(s, P)))}
+
+            opt_state = jax.tree_util.tree_map(
+                lambda x: place(x, shapes.get(getattr(x, "shape", None),
+                                              P())), opt_state)
+        return params, opt_state
+
+    def step(params, opt_state, batch):
+        (total, ce), grads = jax.value_and_grad(
+            lambda p: loss(p, batch), has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": total, "ce": ce}
+
+    batch_sh = {"input_ids": NamedSharding(mesh, P("dp", None)),
+                "labels": NamedSharding(mesh, P("dp", None))}
+    step_jit = jax.jit(step, in_shardings=(param_sh, None, batch_sh),
+                       out_shardings=(param_sh, None, None),
+                       donate_argnums=(0, 1))
+
+    def step_fn(params, opt_state, batch):
+        with mesh:
+            return step_jit(params, opt_state, batch)
+    return step_fn, init_fn
+
+
+# ---------------------------------------------------------------------------
+# eager Layer face
+# ---------------------------------------------------------------------------
+
+from ..nn.layer.layers import Layer, Parameter  # noqa: E402
+from ..core.tensor import Tensor, apply_op  # noqa: E402
+
+
+class LlamaForCausalLM(Layer):
+    """Eager/dygraph face over the functional core: parameters are the same
+    stacked pytree exposed as Layer parameters, so state_dict naming is
+    stable and the eager forward matches forward_pure bit-for-bit."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        key = jax.random.PRNGKey(0)
+        raw = init_params(config, key)
+        self._flat = {}
+        for name, arr in _flatten_params(raw):
+            p = Parameter(arr)
+            p.name = name
+            self.add_parameter(name.replace(".", "_"), p)
+            self._flat[name] = p
+
+    def _tree(self):
+        raw = {}
+        for name, p in self._flat.items():
+            raw[name] = p._array
+        return _unflatten_params(raw)
+
+    def forward(self, input_ids, labels=None):
+        cfg = self.config
+        flat_names = list(self._flat)
+        tensors = [self._flat[n] for n in flat_names]
+
+        def _f(ids, *arrs):
+            raw = dict(zip(flat_names, arrs))
+            params = _unflatten_params(raw)
+            logits, aux = forward_pure(cfg, params, ids)
+            return logits
+        ids_t = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(input_ids)))
+        logits = apply_op(_f, ids_t, *tensors, op_name="llama_forward")
+        if labels is not None:
+            from ..nn import functional as F
+            from ..tensor.manipulation import reshape
+            V = logits.shape[-1]
+            loss = F.cross_entropy(reshape(logits, [-1, V]),
+                                   reshape(labels, [-1]))
+            return loss, logits
+        return logits
+
+
+def _flatten_params(tree, prefix=""):
+    out = []
+    for k, v in tree.items():
+        name = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.extend(_flatten_params(v, name))
+        else:
+            out.append((name, v))
+    return out
+
+
+def _unflatten_params(flat):
+    tree = {}
+    for name, v in flat.items():
+        parts = name.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree

@@ -1,0 +1,8 @@
+"""paddle.nn.functional parity surface."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from ...tensor.manipulation import pad  # noqa: F401

@@ -1,0 +1,236 @@
+"""Activation functionals.
+
+Reference analog: python/paddle/nn/functional/activation.py, PHI activation
+kernels (paddle/phi/kernels/*/activation_kernel*). One jnp/jax.nn call each;
+XLA fuses them into neighboring matmuls (the fused-epilogue analog).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import apply_op
+from ...ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "leaky_relu", "prelu", "elu", "selu", "celu",
+    "gelu", "silu", "swish", "mish", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "softplus", "softsign",
+    "sigmoid", "log_sigmoid", "tanh", "softmax", "log_softmax", "gumbel_softmax",
+    "maxout", "glu", "rrelu", "thresholded_relu",
+]
+
+
+def relu(x, name=None):
+    return apply_op(lambda a: jnp.maximum(a, 0), _ensure_tensor(x),
+                    op_name="relu")
+
+
+def relu_(x):
+    from ...core.tensor import rebind_inplace, tape_snapshot
+    return rebind_inplace(x, relu(tape_snapshot(x)))
+
+
+def relu6(x, name=None):
+    return apply_op(lambda a: jnp.clip(a, 0, 6), _ensure_tensor(x),
+                    op_name="relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jnp.where(a >= 0, a, negative_slope * a),
+                    _ensure_tensor(x), op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = _ensure_tensor(x), _ensure_tensor(weight)
+
+    def _f(a, w):
+        if w.size > 1:
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a >= 0, a, w * a)
+    return apply_op(_f, x, weight, op_name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jnp.where(a > 0, a,
+                                        alpha * (jnp.exp(a) - 1)),
+                    _ensure_tensor(x), op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        lambda a: scale * jnp.where(a > 0, a, alpha * (jnp.exp(a) - 1)),
+        _ensure_tensor(x), op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(
+        lambda a: jnp.maximum(a, 0) + jnp.minimum(
+            0, alpha * (jnp.exp(a / alpha) - 1)),
+        _ensure_tensor(x), op_name="celu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate),
+                    _ensure_tensor(x), op_name="gelu")
+
+
+def silu(x, name=None):
+    return apply_op(lambda a: a * lax.logistic(a), _ensure_tensor(x),
+                    op_name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)),
+                    _ensure_tensor(x), op_name="mish")
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda a: a * jnp.clip(a + 3, 0, 6) / 6,
+                    _ensure_tensor(x), op_name="hardswish")
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0, 1),
+                    _ensure_tensor(x), op_name="hardsigmoid")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply_op(lambda a: jnp.clip(a, min, max), _ensure_tensor(x),
+                    op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+        _ensure_tensor(x), op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        _ensure_tensor(x), op_name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), _ensure_tensor(x),
+                    op_name="tanhshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta),
+        _ensure_tensor(x), op_name="softplus")
+
+
+def softsign(x, name=None):
+    return apply_op(lambda a: a / (1 + jnp.abs(a)), _ensure_tensor(x),
+                    op_name="softsign")
+
+
+def sigmoid(x, name=None):
+    return apply_op(lax.logistic, _ensure_tensor(x), op_name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, _ensure_tensor(x),
+                    op_name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, _ensure_tensor(x), op_name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if dtype is not None:
+            from ...core import dtype as dtype_mod
+            a = a.astype(dtype_mod.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op(_f, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if dtype is not None:
+            from ...core import dtype as dtype_mod
+            a = a.astype(dtype_mod.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op(_f, x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_key
+    x = _ensure_tensor(x)
+    key = next_key()
+
+    def _f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx,
+                                        jnp.ones_like(idx, y.dtype), axis,
+                                        inplace=False)
+            y = onehot + y - lax.stop_gradient(y)
+        return y
+    return apply_op(_f, x, op_name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op(_f, x, op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * lax.logistic(a2)
+    return apply_op(_f, x, op_name="glu")
+
+
+def rrelu(x, lower=1 / 8.0, upper=1 / 3.0, training=True, name=None):
+    from ...framework.random import next_key
+    x = _ensure_tensor(x)
+    if training:
+        key = next_key()
+
+        def _f(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply_op(_f, x, op_name="rrelu")
+    mid = (lower + upper) / 2
+    return leaky_relu(x, mid)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a, value),
+                    _ensure_tensor(x), op_name="thresholded_relu")
+
+
+for _n in __all__:
+    if not _n.endswith("_"):
+        register(_n, globals()[_n])

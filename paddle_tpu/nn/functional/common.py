@@ -1,0 +1,360 @@
+"""Common functionals: linear, dropout, embedding, interpolate, attention.
+
+Reference analog: python/paddle/nn/functional/common.py + input.py +
+fused attention ops (paddle/fluid/operators/fused/fused_attention_op.cu —
+here scaled_dot_product_attention is a single jnp composition XLA fuses;
+a Pallas flash-attention kernel overrides it for long sequences via
+paddle_tpu.ops.pallas_ops when available).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...ops.registry import register, _ensure_tensor
+from ...framework.random import next_key
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "label_smooth", "cosine_similarity",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "interpolate",
+    "upsample", "bilinear", "unfold", "fold", "scaled_dot_product_attention",
+    "pairwise_distance", "zeropad2d",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout."""
+    x, weight = _ensure_tensor(x), _ensure_tensor(weight)
+    if bias is not None:
+        return apply_op(lambda a, w, b: jnp.matmul(a, w) + b, x, weight,
+                        _ensure_tensor(bias), op_name="linear")
+    return apply_op(jnp.matmul, x, weight, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None, rng_key=None):
+    x = _ensure_tensor(x)
+    if not training or p == 0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(lambda a: a * (1 - p), x, op_name="dropout_infer")
+        return x
+    key = rng_key if rng_key is not None else next_key()
+
+    def _f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op(_f, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _ensure_tensor(x)
+    if not training or p == 0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _f(a):
+        keep = jax.random.bernoulli(key, 1 - p, a.shape)
+        q = 1 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return apply_op(_f, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = _ensure_tensor(x), _ensure_tensor(weight)
+
+    def _f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op(_f, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(
+        lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes),
+        x, op_name="one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = _ensure_tensor(label)
+    args = [label]
+    if prior_dist is not None:
+        args.append(_ensure_tensor(prior_dist))
+
+    def _f(y, *pd):
+        k = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / k
+    return apply_op(_f, *args, op_name="label_smooth")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = _ensure_tensor(x1), _ensure_tensor(x2)
+
+    def _f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op(_f, x1, x2, op_name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(
+        lambda a, b: jnp.sum(jnp.abs(a - b + epsilon) ** p, axis=-1,
+                             keepdims=keepdim) ** (1.0 / p),
+        x, y, op_name="pairwise_distance")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+    r = upscale_factor
+
+    def _f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return apply_op(_f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+    r = downscale_factor
+
+    def _f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return apply_op(_f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, groups, c // groups, h, w)
+            out = out.transpose(0, 2, 1, 3, 4)
+            return out.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, groups, c // groups)
+        out = out.transpose(0, 1, 2, 4, 3)
+        return out.reshape(n, h, w, c)
+    return apply_op(_f, x, op_name="channel_shuffle")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = _ensure_tensor(x)
+    channels_last = data_format.endswith("C") and len(data_format) > 3 \
+        or data_format in ("NHWC", "NDHWC", "NLC")
+    nd = x.ndim - 2
+    spatial = x.shape[1:1 + nd] if channels_last else x.shape[2:2 + nd]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                     for s in (size if isinstance(size, (list, tuple))
+                               else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        out_sizes = [int(s * f) for s, f in zip(spatial, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic",
+             "area": "linear"}[mode]
+
+    def _f(a):
+        if channels_last:
+            new_shape = (a.shape[0],) + tuple(out_sizes) + (a.shape[-1],)
+        else:
+            new_shape = a.shape[:2] + tuple(out_sizes)
+        if jmode == "nearest":
+            # paddle nearest: floor(src = dst * scale)
+            idxs = []
+            for i, (n_in, n_out) in enumerate(zip(spatial, out_sizes)):
+                scale_ = n_in / n_out
+                idx = jnp.floor(jnp.arange(n_out) * scale_).astype(jnp.int32)
+                idxs.append(jnp.clip(idx, 0, n_in - 1))
+            out = a
+            for i, idx in enumerate(idxs):
+                ax = (1 if channels_last else 2) + i
+                out = jnp.take(out, idx, axis=ax)
+            return out
+        method = jmode
+        return jax.image.resize(a, new_shape, method=method)
+    return apply_op(_f, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2 = _ensure_tensor(x1), _ensure_tensor(x2)
+    weight = _ensure_tensor(weight)
+    args = [x1, x2, weight]
+    if bias is not None:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+    return apply_op(_f, *args, op_name="bilinear")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (paddle layout: NCHW -> [N, C*kh*kw, L])."""
+    x = _ensure_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+
+    def _f(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        patches = lax.conv_general_dilated_patches(
+            a_p, filter_shape=ks, window_strides=st, padding="VALID",
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply_op(_f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = _ensure_tensor(x)
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) \
+        else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def _f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os_[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os_[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        a_r = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]),
+                        a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wj = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0],
+                             wj:wj + ow * st[1]:st[1]].add(a_r[:, :, i, j])
+        return out[:, :, pd[0]:out.shape[2] - pd[0],
+                   pd[1]:out.shape[3] - pd[1]]
+    return apply_op(_f, x, op_name="fold")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad as pad_fn
+    return pad_fn(x, padding, mode="constant", value=0.0,
+                  data_format=data_format)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Fused-attention surface (reference: fused_attention_op.cu).
+
+    Layout: [batch, seq, heads, head_dim] (paddle/flash-attn convention).
+    Lowered as one jnp composition; XLA fuses QK^T+softmax+PV. For long
+    sequences the Pallas flash kernel (ops/pallas_ops.py) is used instead
+    when shapes allow.
+    """
+    query, key, value = (_ensure_tensor(query), _ensure_tensor(key),
+                         _ensure_tensor(value))
+    args = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        args.append(_ensure_tensor(attn_mask))
+    drop_key = next_key() if (dropout_p > 0 and training) else None
+
+    def _f(q, k, v, *m):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        # [B,S,H,D] -> [B,H,S,D]
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * scale
+        if is_causal:
+            s, t = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((s, t), bool))
+            logits = jnp.where(causal, logits, -jnp.inf)
+        if m:
+            mask = m[0]
+            if mask.dtype == jnp.bool_:
+                logits = jnp.where(mask, logits, -jnp.inf)
+            else:
+                logits = logits + mask
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(q.dtype)
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1 - dropout_p), 0.0)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+    return apply_op(_f, *args, op_name="scaled_dot_product_attention")
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

@@ -1,0 +1,172 @@
+"""Convolution functionals.
+
+Reference analog: python/paddle/nn/functional/conv.py over PHI conv kernels
+(gpudnn). TPU-native: lax.conv_general_dilated, which XLA maps onto the MXU
+with automatic im2col-free tiling; layouts follow paddle's NCHW/OIHW default
+with NHWC accepted (NHWC is the TPU-preferred layout — XLA transposes
+internally either way).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import apply_op
+from ...ops.registry import register, _ensure_tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # full-form [[0,0],[0,0],[ph,ph],[pw,pw]] — keep spatial entries
+        return [tuple(p) for p in padding[-n:]]
+    return [(int(p), int(p)) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
+          nd, op_name):
+    x = _ensure_tensor(x)
+    weight = _ensure_tensor(weight)
+    stride = _tuplize(stride, nd)
+    dilation = _tuplize(dilation, nd)
+    pad = _pad_cfg(padding, nd)
+    channels_last = data_format.endswith("C")
+    sp = "DHW"[3 - nd:]
+    if channels_last:
+        dn_str = ("N" + sp + "C", "O" + sp + "I", "N" + sp + "C")
+    else:
+        dn_str = ("NC" + sp, "OI" + sp, "NC" + sp)
+    # paddle weights are always OI<sp> regardless of data_format
+    dn_lhs = dn_str[0]
+    dn = lax.conv_dimension_numbers((1,) * (nd + 2), weight._array.shape,
+                                    (dn_lhs, "OI" + sp, dn_lhs))
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, w, b=None):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=dn)
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[-1 if channels_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply_op(_f, *args, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCH" if data_format in ("NCL", "NCH") else "NHC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 fmt, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 data_format, 3, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, nd, op_name,
+                    output_size=None):
+    x = _ensure_tensor(x)
+    weight = _ensure_tensor(weight)
+    stride = _tuplize(stride, nd)
+    dilation = _tuplize(dilation, nd)
+    outpad = _tuplize(output_padding, nd)
+    channels_last = data_format.endswith("C")
+    sp = "DHW"[3 - nd:]
+    dn_lhs = ("N" + sp + "C") if channels_last else ("NC" + sp)
+    dn = lax.conv_dimension_numbers((1,) * (nd + 2), weight._array.shape,
+                                    (dn_lhs, "IO" + sp, dn_lhs))
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        base = _pad_cfg(padding, nd)
+        # transpose conv: effective padding = k_eff - 1 - p
+        ks = weight._array.shape[2:]
+        pad = []
+        for i in range(nd):
+            k_eff = (ks[i] - 1) * dilation[i] + 1
+            lo = k_eff - 1 - base[i][0]
+            hi = k_eff - 1 - base[i][1] + outpad[i]
+            pad.append((lo, hi))
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, w, b=None):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups, dimension_numbers=dn)
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[-1 if channels_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply_op(_f, *args, op_name=op_name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    fmt = "NCH" if data_format in ("NCL", "NCH") else "NHC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, fmt, 1, "conv1d_transpose",
+                           output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2,
+                           "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3,
+                           "conv3d_transpose", output_size)
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

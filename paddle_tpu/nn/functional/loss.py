@@ -1,0 +1,415 @@
+"""Loss functionals.
+
+Reference analog: python/paddle/nn/functional/loss.py over PHI
+softmax_with_cross_entropy etc. cross_entropy keeps paddle's signature
+(soft_label, ignore_index, weight, axis).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
+    "sigmoid_focal_loss", "log_loss", "npair_loss", "softmax_cross_entropy_with_logits",
+    "multi_label_soft_margin_loss", "soft_margin_loss", "poisson_nll_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input = _ensure_tensor(input)
+    label = _ensure_tensor(label)
+    args = [input, label]
+    has_w = weight is not None
+    if has_w:
+        args.append(_ensure_tensor(weight))
+
+    def _f(logits, lab, *w):
+        ax = axis % logits.ndim
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=ax)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15))
+        n_class = logits.shape[ax]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            per = -jnp.sum(soft * logp, axis=ax)
+            if w:
+                cw = jnp.sum(soft * w[0].reshape(
+                    [1] * ax + [-1] + [1] * (logits.ndim - ax - 1)), axis=ax)
+                per = per * cw
+            return _reduce(per, reduction)
+        lab_ = lab
+        if lab_.ndim == logits.ndim and lab_.shape[ax] == 1:
+            lab_ = jnp.squeeze(lab_, axis=ax)
+        lab_int = lab_.astype(jnp.int32)
+        valid = lab_int != ignore_index
+        safe_lab = jnp.where(valid, lab_int, 0)
+        if label_smoothing > 0:
+            onehot = jax.nn.one_hot(safe_lab, n_class, axis=ax,
+                                    dtype=logp.dtype)
+            soft = onehot * (1 - label_smoothing) + label_smoothing / n_class
+            per = -jnp.sum(soft * logp, axis=ax)
+        else:
+            per = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_lab, ax), axis=ax).squeeze(ax)
+        per = jnp.where(valid, per, 0.0)
+        if w:
+            cw = w[0][safe_lab]
+            cw = jnp.where(valid, cw, 0.0)
+            per = per * cw
+            if reduction == "mean":
+                return jnp.sum(per) / jnp.maximum(jnp.sum(cw), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as softmax_fn
+    from ...tensor.manipulation import unsqueeze
+    if not soft_label:
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def softmax_cross_entropy_with_logits(logits, labels, axis=-1):
+    return cross_entropy(logits, labels, soft_label=True, axis=axis,
+                         reduction="none")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    input = _ensure_tensor(input)
+    label = _ensure_tensor(label)
+    args = [input, label]
+    if weight is not None:
+        args.append(_ensure_tensor(weight))
+
+    def _f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            per = per * w[0]
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit = _ensure_tensor(logit)
+    label = _ensure_tensor(label)
+    args = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        args.append(_ensure_tensor(weight))
+    if has_pw:
+        args.append(_ensure_tensor(pos_weight))
+
+    def _f(z, y, *rest):
+        i = 0
+        w = rest[i] if has_w else None
+        i += 1 if has_w else 0
+        pw = rest[i] if has_pw else None
+        max_val = jnp.maximum(-z, 0)
+        if pw is not None:
+            log_weight = (pw - 1) * y + 1
+            per = (1 - y) * z + log_weight * (
+                jnp.log(jnp.exp(-max_val) + jnp.exp(-z - max_val)) + max_val)
+        else:
+            per = (1 - y) * z + max_val + jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-z - max_val))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="bce_with_logits")
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction),
+                    input, label, op_name="mse_loss")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+    return apply_op(lambda a, b: (a - b) ** 2, input, label,
+                    op_name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label, op_name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    input = _ensure_tensor(input)
+    label = _ensure_tensor(label)
+    args = [input, label]
+    if weight is not None:
+        args.append(_ensure_tensor(weight))
+
+    def _f(logp, y, *w):
+        y_int = y.astype(jnp.int32)
+        valid = y_int != ignore_index
+        safe = jnp.where(valid, y_int, 0)
+        per = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1),
+                                   axis=1).squeeze(1)
+        cw = w[0][safe] if w else jnp.ones_like(per)
+        cw = jnp.where(valid, cw, 0.0)
+        per = per * cw
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(cw), 1e-12)
+        per = jnp.where(valid, per, 0.0)
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="nll_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+
+    def _f(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        per = jnp.where(abs_d < delta, 0.5 * d * d / delta,
+                        abs_d - 0.5 * delta)
+        return _reduce(per, reduction)
+    return apply_op(_f, input, label, op_name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+
+    def _f(logp, y):
+        per = y * (jnp.log(jnp.clip(y, 1e-12)) - logp)
+        return _reduce(per, reduction)
+    return apply_op(_f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    input, other = _ensure_tensor(input), _ensure_tensor(other)
+    label = _ensure_tensor(label)
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0, -y * (a - b) + margin),
+                                reduction),
+        input, other, label, op_name="margin_ranking_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2 = _ensure_tensor(input1), _ensure_tensor(input2)
+    label = _ensure_tensor(label)
+
+    def _f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0, cos - margin))
+        return _reduce(per, reduction)
+    return apply_op(_f, input1, input2, label,
+                    op_name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",  # noqa: A002
+                         name=None):
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+
+    def _f(a, y):
+        per = jnp.where(y == 1, a, jnp.maximum(0, margin - a))
+        return _reduce(per, reduction)
+    return apply_op(_f, input, label, op_name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    input = _ensure_tensor(input)
+    positive, negative = _ensure_tensor(positive), _ensure_tensor(negative)
+
+    def _f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p,
+                           axis=-1) ** (1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        per = jnp.maximum(d_pos - d_neg + margin, 0)
+        return _reduce(per, reduction)
+    return apply_op(_f, input, positive, negative,
+                    op_name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha-recursion in log space (lax.scan)."""
+    log_probs = _ensure_tensor(log_probs)   # [T, B, C] (paddle layout)
+    labels = _ensure_tensor(labels)         # [B, S]
+    input_lengths = _ensure_tensor(input_lengths)
+    label_lengths = _ensure_tensor(label_lengths)
+
+    def _f(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = -1e30
+
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_prevprev = jnp.concatenate(
+            [jnp.ones((B, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prevprev, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new_alpha = merged + emit
+            return new_alpha, new_alpha
+
+        _, alphas = lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,L]
+
+        t_idx = (in_len.astype(jnp.int32) - 1)
+        final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+        l_end = 2 * lab_len.astype(jnp.int32)
+        p_blank = jnp.take_along_axis(final, l_end[:, None], axis=1)[:, 0]
+        p_label = jnp.take_along_axis(
+            final, jnp.maximum(l_end - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(p_blank, p_label)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1))
+        return _reduce(loss, reduction)
+    return apply_op(_f, log_probs, labels, input_lengths, label_lengths,
+                    op_name="ctc_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = _ensure_tensor(logit), _ensure_tensor(label)
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(_ensure_tensor(normalizer))
+
+    def _f(z, y, *nz):
+        p = lax.logistic(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * ((1 - p_t) ** gamma) * ce
+        if nz:
+            per = per / nz[0]
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="sigmoid_focal_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon)
+        - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label, op_name="log_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive = _ensure_tensor(anchor), _ensure_tensor(positive)
+    labels = _ensure_tensor(labels)
+
+    def _f(a, p, y):
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        sim = a @ p.T
+        y = y.reshape(-1, 1)
+        same = (y == y.T).astype(sim.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(same * logp, axis=1))
+        return ce + reg
+    return apply_op(_f, anchor, positive, labels, op_name="npair_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean", name=None):
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+    args = [input, label]
+    if weight is not None:
+        args.append(_ensure_tensor(weight))
+
+    def _f(z, y, *w):
+        per = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if w:
+            per = per * w[0]
+        per = jnp.mean(per, axis=-1)
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+    return apply_op(
+        lambda z, y: _reduce(jnp.log1p(jnp.exp(-y * z)), reduction),
+        input, label, op_name="soft_margin_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean", name=None):
+    input, label = _ensure_tensor(input), _ensure_tensor(label)
+
+    def _f(x, y):
+        if log_input:
+            per = jnp.exp(x) - y * x
+        else:
+            per = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y \
+                + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            per = per + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+    return apply_op(_f, input, label, op_name="poisson_nll_loss")
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

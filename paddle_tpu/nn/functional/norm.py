@@ -1,0 +1,226 @@
+"""Normalization functionals.
+
+Reference analog: python/paddle/nn/functional/norm.py over PHI
+batch_norm/layer_norm kernels (paddle/phi/kernels/gpu/layer_norm_kernel.cu
+etc.). XLA fuses the mean/var/normalize chain; rms_norm is the TPU-era
+addition (reference lacks it — PaddleNLP-era op).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...ops.registry import register, _ensure_tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    x = _ensure_tensor(x)
+    ch_axis = x.ndim - 1 if data_format.endswith("C") and x.ndim > 2 else 1
+    if x.ndim == 2:
+        ch_axis = 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    args = [x]
+    names = []
+    for t in (weight, bias):
+        if t is not None:
+            args.append(_ensure_tensor(t))
+    has_w = weight is not None
+    has_b = bias is not None
+
+    rm = running_mean._array if isinstance(running_mean, Tensor) else running_mean
+    rv = running_var._array if isinstance(running_var, Tensor) else running_var
+
+    def _f(a, *wb):
+        i = 0
+        w = wb[i] if has_w else None
+        i += 1 if has_w else 0
+        b = wb[i] if has_b else None
+        if use_batch_stats:
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - mean.reshape(shape)) * lax.rsqrt(
+            var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    out = apply_op(_f, *args, op_name="batch_norm")
+
+    # update running stats in place (matches reference's in-place update);
+    # works under trace too — the new stats become traced values the caller's
+    # functional step can return.
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        batch_mean = jnp.mean(x._array, axis=reduce_axes)
+        batch_var = jnp.var(x._array, axis=reduce_axes)
+        n = 1
+        for ax in reduce_axes:
+            n *= x._array.shape[ax]
+        unbiased = batch_var * (n / max(n - 1, 1))
+        running_mean._set_array(momentum * running_mean._array
+                                + (1 - momentum) * batch_mean)
+        running_var._set_array(momentum * running_var._array
+                               + (1 - momentum) * unbiased)
+    return out
+
+
+import jax  # noqa: E402
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = _ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - nd, x.ndim))
+
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_ensure_tensor(weight))
+    if has_b:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+    return apply_op(_f, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-06, name=None):
+    """RMSNorm (Llama-family). Not in the reference snapshot; included as a
+    first-class op because it is the dominant norm for the LLM configs in
+    BASELINE.json."""
+    x = _ensure_tensor(x)
+    args = [x]
+    if weight is not None:
+        args.append(_ensure_tensor(weight))
+
+    def _f(a, *w):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * lax.rsqrt(ms + epsilon)).astype(dt)
+        if w:
+            out = out * w[0]
+        return out
+    return apply_op(_f, *args, op_name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+    axes = tuple(range(2, x.ndim))
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_ensure_tensor(weight))
+    if has_b:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+    return apply_op(_f, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+    channels_last = data_format.endswith("C") and data_format != "NCHW" \
+        and data_format != "NCDHW"
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_ensure_tensor(weight))
+    if has_b:
+        args.append(_ensure_tensor(bias))
+
+    def _f(a, *wb):
+        if channels_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = num_groups
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - mean) * lax.rsqrt(var + epsilon)).reshape(a_t.shape)
+        shape = [1, c] + [1] * (a_t.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(_f, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        sq = a * a
+        ch_axis = 1
+        c = a.shape[ch_axis]
+        half = size // 2
+        padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)]
+                         + [(0, 0)] * (a.ndim - 2))
+        windows = sum(lax.slice_in_dim(padded, i, i + c, axis=ch_axis)
+                      for i in range(size))
+        div = (k + alpha / size * windows) ** beta
+        return a / div
+    return apply_op(_f, x, op_name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply_op(_f, x, op_name="normalize")
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

@@ -1,0 +1,194 @@
+"""Pooling functionals.
+
+Reference analog: python/paddle/nn/functional/pooling.py over PHI pool
+kernels. TPU-native: lax.reduce_window.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import apply_op
+from ...ops.registry import register, _ensure_tensor
+from .conv import _tuplize, _pad_cfg
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d"]
+
+
+def _pool(x, kernel, stride, padding, nd, reducer, init, channels_last,
+          ceil_mode=False, count_include_pad=True, op_name="pool",
+          average=False):
+    x = _ensure_tensor(x)
+    kernel = _tuplize(kernel, nd)
+    stride = _tuplize(stride if stride is not None else kernel, nd)
+    pad = _pad_cfg(padding, nd)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = [(0, 0)] + list(pad) + [(0, 0)] if channels_last \
+            else [(0, 0), (0, 0)] + list(pad)
+    if channels_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+
+    def _f(a):
+        if average:
+            summed = lax.reduce_window(a, 0.0, lax.add, dims, strides,
+                                       pad_cfg)
+            if count_include_pad or isinstance(pad_cfg, str) or \
+                    all(p == (0, 0) for p in (pad if not isinstance(pad, str) else [])):
+                denom = float(np.prod(kernel))
+                return summed / denom
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                       pad_cfg)
+            return summed / counts
+        return lax.reduce_window(a, init, reducer, dims, strides, pad_cfg)
+    return apply_op(_f, x, op_name=op_name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, lax.max, -jnp.inf,
+                 data_format.endswith("C") and data_format != "NCL",
+                 ceil_mode, op_name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, lax.max, -jnp.inf,
+                 data_format == "NHWC", ceil_mode, op_name="max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, lax.max, -jnp.inf,
+                 data_format == "NDHWC", ceil_mode, op_name="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, lax.add, 0.0,
+                 False, ceil_mode, count_include_pad=not exclusive,
+                 op_name="avg_pool1d", average=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, lax.add, 0.0,
+                 data_format == "NHWC", ceil_mode,
+                 count_include_pad=not exclusive, op_name="avg_pool2d",
+                 average=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, lax.add, 0.0,
+                 data_format == "NDHWC", ceil_mode,
+                 count_include_pad=not exclusive, op_name="avg_pool3d",
+                 average=True)
+
+
+def _adaptive_pool(x, output_size, nd, is_max, channels_last, op_name):
+    x = _ensure_tensor(x)
+    out_sizes = _tuplize(output_size, nd)
+    spatial_axes = list(range(1, 1 + nd)) if channels_last \
+        else list(range(2, 2 + nd))
+
+    def _f(a):
+        out = a
+        for i, ax in enumerate(spatial_axes):
+            n_in = out.shape[ax]
+            n_out = out_sizes[i]
+            if n_out is None or n_out == n_in:
+                continue
+            if n_in % n_out == 0:
+                k = n_in // n_out
+                new_shape = (out.shape[:ax] + (n_out, k)
+                             + out.shape[ax + 1:])
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if is_max \
+                    else jnp.mean(r, axis=ax + 1)
+            else:
+                # variable-window adaptive pooling (torch-style bounds)
+                starts = (np.arange(n_out) * n_in) // n_out
+                ends = ((np.arange(n_out) + 1) * n_in + n_out - 1) // n_out
+                slices = []
+                for s, e in zip(starts, ends):
+                    piece = lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = jnp.max(piece, axis=ax, keepdims=True) if is_max \
+                        else jnp.mean(piece, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+    return apply_op(_f, x, op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, False,
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, False, data_format == "NHWC",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, False, data_format == "NDHWC",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, True, False,
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, True, False,
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, True, False,
+                          "adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    x = _ensure_tensor(x)
+    p = float(norm_type)
+    from ...core.tensor import apply_op as _ap
+    powed = _ap(lambda a: jnp.abs(a) ** p, x, op_name="lp_pow")
+    pooled = avg_pool1d(powed, kernel_size, stride, padding,
+                        exclusive=False, ceil_mode=ceil_mode)
+    k = kernel_size if isinstance(kernel_size, int) else int(
+        np.prod(kernel_size))
+    return _ap(lambda a: (a * k) ** (1.0 / p), pooled, op_name="lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    x = _ensure_tensor(x)
+    p = float(norm_type)
+    from ...core.tensor import apply_op as _ap
+    powed = _ap(lambda a: jnp.abs(a) ** p, x, op_name="lp_pow")
+    pooled = avg_pool2d(powed, kernel_size, stride, padding,
+                        exclusive=False)
+    ks = _tuplize(kernel_size, 2)
+    k = int(np.prod(ks))
+    return _ap(lambda a: (a * k) ** (1.0 / p), pooled, op_name="lp_root")
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

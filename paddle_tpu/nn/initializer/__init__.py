@@ -1,0 +1,214 @@
+"""Weight initializers.
+
+Reference analog: python/paddle/nn/initializer/ (Constant/Normal/Uniform/
+Xavier/Kaiming/TruncatedNormal/Orthogonal/Assign/Dirac) backed there by
+fill-op programs; here each initializer is a pure function
+(shape, dtype) -> jnp array drawn from the global Generator's keys.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.random import next_key
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+]
+
+_GLOBAL = {"weight": None, "bias": None}
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return (jax.random.normal(next_key(), tuple(shape),
+                                  dtype=jnp.float32) * self.std
+                + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        z = jax.random.truncated_normal(next_key(), self.a, self.b,
+                                        tuple(shape), dtype=jnp.float32)
+        return (z * self.std + self.mean).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(next_key(), tuple(shape), dtype=jnp.float32,
+                                  minval=self.low,
+                                  maxval=self.high).astype(dtype)
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        # paddle convention: shape[0]=fan_in-ish for Linear ([in,out]),
+        # conv weights are [out_c, in_c, *k]
+        if len(shape) == 2:
+            fan_in, fan_out = shape[0], shape[1]
+        else:
+            fan_in = shape[1] * receptive
+            fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * _math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(next_key(), tuple(shape),
+                                  dtype=jnp.float32) * std).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * _math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), tuple(shape), dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / _math.sqrt(fi)
+        return (jax.random.normal(next_key(), tuple(shape),
+                                  dtype=jnp.float32) * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * _math.sqrt(3.0 / fi)
+        return jax.random.uniform(next_key(), tuple(shape), dtype=jnp.float32,
+                                  minval=-limit, maxval=limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ...core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._array
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign initializer shape mismatch {arr.shape} vs {shape}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        init = jax.nn.initializers.orthogonal(scale=self.gain)
+        return init(next_key(), tuple(shape), jnp.float32).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        out_c, in_c = shape[0], shape[1]
+        arr = np.zeros(shape, dtype=np.float32)
+        centers = [k // 2 for k in shape[2:]]
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                idx = (g * per + i, i) + tuple(centers)
+                arr[idx] = 1.0
+        return jnp.asarray(arr, dtype=dtype)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return _math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        slope = param if param is not None else 0.01
+        return _math.sqrt(2.0 / (1 + slope ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    raise ValueError(f"unsupported nonlinearity {nonlinearity}")
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    _GLOBAL["weight"] = weight_init
+    _GLOBAL["bias"] = bias_init
+
+
+def _resolve_initializer(attr, default_initializer=None, is_bias=False):
+    """ParamAttr/initializer resolution (fluid.initializer analog)."""
+    from ...framework.param_attr import ParamAttr
+    if isinstance(attr, Initializer):
+        return attr
+    if isinstance(attr, ParamAttr) and attr.initializer is not None:
+        return attr.initializer
+    if default_initializer is not None:
+        return default_initializer
+    g = _GLOBAL["bias" if is_bias else "weight"]
+    if g is not None:
+        return g
+    return Constant(0.0) if is_bias else XavierUniform()

@@ -1,0 +1,98 @@
+"""nn.utils: weight_norm/spectral_norm wrappers, parameter flattening.
+
+Reference analog: python/paddle/nn/utils/.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ..layer.layers import Parameter
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [p._array.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    arr = vec._array if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p._array.shape)) if p._array.shape else 1
+        p._set_array(arr[offset:offset + n].reshape(p._array.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| via a forward-pre-hook."""
+    weight = getattr(layer, name)
+    w = weight._array
+    if dim is None:
+        norm = jnp.linalg.norm(w)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+    g = Parameter(norm)
+    v = Parameter(w)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def compute(lyr):
+        vv = getattr(lyr, name + "_v")._array
+        gg = getattr(lyr, name + "_g")._array
+        if dim is None:
+            nrm = jnp.linalg.norm(vv)
+        else:
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            nrm = jnp.sqrt(jnp.sum(vv * vv, axis=axes, keepdims=True))
+        w_t = Tensor(gg * vv / jnp.maximum(nrm, 1e-12))
+        w_t.stop_gradient = False
+        object.__setattr__(lyr, name, w_t)
+
+    def hook(lyr, inputs):
+        compute(lyr)
+        return None
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_name = name
+    compute(layer)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_weight_norm_handle"):
+        layer._weight_norm_handle.remove()
+    g = layer._parameters.pop(name + "_g", None)
+    v = layer._parameters.pop(name + "_v", None)
+    if v is not None:
+        w = getattr(layer, name)
+        p = Parameter(w._array if isinstance(w, Tensor) else v._array)
+        layer.add_parameter(name, p)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm as _SN
+    weight = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(weight.shape, dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = Parameter(weight._array)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        w = sn(getattr(lyr, name + "_orig"))
+        object.__setattr__(lyr, name, w)
+        return None
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

@@ -1,0 +1,1 @@
+from .registry import OP_LIBRARY, OpInfo, register, get_op, list_ops

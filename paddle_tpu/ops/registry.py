@@ -1,0 +1,67 @@
+"""Op registry.
+
+Reference analog: phi::KernelFactory (paddle/phi/core/kernel_factory.h:314)
+plus the YAML op codegen (paddle/phi/api/yaml/ops.yaml -> api_gen.py). On the
+TPU stack there is exactly one "backend" — XLA — so the registry's job is not
+multi-backend dispatch but: (a) a single source of truth for the op surface
+(name -> python callable + jnp lowering) used by tests/introspection, and
+(b) the hook point where a Pallas implementation can override the jnp
+lowering for hot ops (the fusion/ and gpudnn/ analog).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+
+
+class OpInfo(NamedTuple):
+    name: str
+    fn: Callable          # public python API (Tensor-level)
+    lowering: Callable    # jnp-level implementation (array-level)
+
+
+OP_LIBRARY: Dict[str, OpInfo] = {}
+
+
+def register(name: str, fn: Callable, lowering: Optional[Callable] = None):
+    OP_LIBRARY[name] = OpInfo(name, fn, lowering or fn)
+    return fn
+
+
+def get_op(name: str) -> OpInfo:
+    if name not in OP_LIBRARY:
+        raise KeyError(f"op '{name}' not registered; have {len(OP_LIBRARY)} ops")
+    return OP_LIBRARY[name]
+
+
+def list_ops():
+    return sorted(OP_LIBRARY)
+
+
+def _ensure_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x)
+
+
+def unary_op(name: str, jfn: Callable, doc: str = ""):
+    """Build + register a Tensor-level unary elementwise op from a jnp fn."""
+    def op(x, name=None):  # noqa: A002 - paddle APIs take a `name` kwarg
+        return apply_op(jfn, _ensure_tensor(x), op_name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} (lowered to jnp/XLA)."
+    register(name, op, jfn)
+    return op
+
+
+def binary_op(name: str, jfn: Callable, doc: str = ""):
+    def op(x, y, name=None):  # noqa: A002
+        return apply_op(jfn, _ensure_tensor(x), _ensure_tensor(y),
+                        op_name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = doc or f"Elementwise {name} with numpy broadcasting."
+    register(name, op, jfn)
+    return op

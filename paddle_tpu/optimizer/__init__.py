@@ -1,0 +1,3 @@
+from .optimizer import (Optimizer, SGD, Momentum, Adagrad, Adam, AdamW,
+                        Adamax, RMSProp, Adadelta, Lamb)
+from . import lr

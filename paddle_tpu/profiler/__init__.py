@@ -1,0 +1,192 @@
+"""Profiler.
+
+Reference analog: python/paddle/profiler/profiler.py:344 (Profiler with
+make_scheduler state machine, chrome-trace export) over the C++ HostTracer/
+CudaTracer (paddle/fluid/platform/profiler/). TPU-native: jax.profiler
+(xprof) captures device traces; RecordEvent instruments host spans into the
+same trace via jax.profiler.TraceAnnotation.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from enum import Enum
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
+           "RecordEvent", "export_chrome_tracing", "benchmark"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference: profiler.py:117 — step-indexed state machine."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof._log_dir = dir_name
+    return handler
+
+
+class RecordEvent:
+    """Host-span annotation visible in the xprof trace
+    (reference: paddle/fluid/platform/profiler/event_tracing.h)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._log_dir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                       "/tmp/paddle_tpu_profile")
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._active = False
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        self._state = self._scheduler(self._step) if self._scheduler \
+            else ProfilerState.RECORD
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN) \
+                and not self._timer_only:
+            jax.profiler.start_trace(self._log_dir)
+            self._active = True
+        self._last = time.perf_counter()
+
+    def stop(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+        if self._scheduler is None:
+            return
+        new_state = self._scheduler(self._step)
+        if new_state != self._state:
+            recording = self._state in (ProfilerState.RECORD,
+                                        ProfilerState.RECORD_AND_RETURN)
+            will_record = new_state in (ProfilerState.RECORD,
+                                        ProfilerState.RECORD_AND_RETURN)
+            if will_record and not self._active and not self._timer_only:
+                jax.profiler.start_trace(self._log_dir)
+                self._active = True
+            if recording and not will_record and self._active:
+                jax.profiler.stop_trace()
+                self._active = False
+            self._state = new_state
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+        arr = np.asarray(self._step_times[-100:])
+        return (f"avg step: {arr.mean() * 1000:.2f} ms, "
+                f"ips: {1.0 / max(arr.mean(), 1e-9):.2f} steps/s")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        print(self.step_info(), flush=True)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class benchmark:
+    """reference: profiler/timer.py — ips reporting helper."""
+
+    def __init__(self):
+        self._times = []
+        self._last = None
+
+    def begin(self):
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    def end(self):
+        pass
+
+    def report(self):
+        import numpy as np
+        arr = np.asarray(self._times or [0.0])
+        return {"avg_s": float(arr.mean()), "steps": len(self._times)}

@@ -1,0 +1,105 @@
+"""paddle.signal parity (reference: python/paddle/signal.py): stft/istft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply_op
+from .ops.registry import _ensure_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        framed = moved[..., idx]  # [..., num, frame_length]
+        framed = jnp.swapaxes(framed, -1, -2)  # [..., frame_length, num]
+        return framed if axis in (-1, a.ndim - 1) else jnp.moveaxis(
+            framed, (-2, -1), (axis, axis + 1))
+    return apply_op(_f, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        # a: [..., frame_length, num_frames] (axis=-1 layout)
+        fl = a.shape[-2]
+        num = a.shape[-1]
+        n = (num - 1) * hop_length + fl
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                a[..., :, i])
+        return out
+    return apply_op(_f, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    x = _ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_arr = window._array if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win_arr = jnp.pad(win_arr, (pad, n_fft - win_length - pad))
+
+    def _f(a):
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2,
+                                                       n_fft // 2)],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = a[..., idx] * win_arr  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, frames]
+    return apply_op(_f, x, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    x = _ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_arr = window._array if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win_arr = jnp.pad(win_arr, (pad, n_fft - win_length - pad))
+
+    def _f(spec):
+        spec = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(n_fft)
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.real(jnp.fft.ifft(spec, axis=-1))
+        frames = frames * win_arr
+        num = frames.shape[-2]
+        n = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros(n, frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(win_arr * win_arr)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[..., n_fft // 2:-(n_fft // 2)]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply_op(_f, x, op_name="istft")

@@ -1,0 +1,152 @@
+"""Sparse tensors.
+
+Reference analog: python/paddle/sparse/ over phi SparseCooTensor/
+SparseCsrTensor kernels (paddle/phi/core/sparse_coo_tensor.h,
+kernels/sparse/ 14k LoC). TPU-native: jax.experimental.sparse BCOO is the
+backing representation (XLA lowers scatter/gather-based spmm); dense
+round-trips are exact. Covers the creation + conversion + elementwise +
+matmul surface of the reference's paddle.sparse.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "subtract", "multiply", "divide",
+           "matmul", "relu", "tanh", "sqrt", "sin", "abs", "pow", "neg",
+           "cast", "transpose", "sum"]
+
+
+class SparseCooTensor(Tensor):
+    """Tensor wrapper over a BCOO array; .indices()/.values()/to_dense()."""
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense(), stop_gradient=self.stop_gradient)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    @property
+    def nnz(self):
+        return self._bcoo.nse
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = indices._array if isinstance(indices, Tensor) \
+        else jnp.asarray(np.asarray(indices))
+    val = values._array if isinstance(values, Tensor) \
+        else jnp.asarray(np.asarray(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    idx_t = jnp.swapaxes(idx, 0, 1).astype(jnp.int32)  # BCOO wants [nse, ndim]
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in jnp.max(idx, axis=1))
+    bcoo = jsparse.BCOO((val, idx_t), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    # represent CSR via COO (BCOO backing); row expansion on host
+    crows_np = np.asarray(crows._array if isinstance(crows, Tensor)
+                          else crows)
+    cols_np = np.asarray(cols._array if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return sparse_coo_tensor(indices, values, shape, dtype, place,
+                             stop_gradient)
+
+
+def _sparse_unary(name, fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            b = x._bcoo
+            out = jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)
+            return SparseCooTensor(out, stop_gradient=x.stop_gradient)
+        return Tensor(fn(x._array))
+    op.__name__ = name
+    return op
+
+
+relu = _sparse_unary("relu", lambda v: jnp.maximum(v, 0))
+tanh = _sparse_unary("tanh", jnp.tanh)
+sqrt = _sparse_unary("sqrt", jnp.sqrt)
+sin = _sparse_unary("sin", jnp.sin)
+abs = _sparse_unary("abs", jnp.abs)  # noqa: A001
+neg = _sparse_unary("neg", jnp.negative)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _sparse_unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        data = b.data.astype(convert_dtype(value_dtype)) \
+            if value_dtype else b.data
+        return SparseCooTensor(jsparse.BCOO((data, b.indices),
+                                            shape=b.shape))
+    return Tensor(x._array.astype(convert_dtype(value_dtype)))
+
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        xd = x.to_dense()._array if isinstance(x, SparseCooTensor) \
+            else x._array
+        yd = y.to_dense()._array if isinstance(y, SparseCooTensor) \
+            else y._array
+        dense = fn(xd, yd)
+        idx = jnp.stack(jnp.nonzero(dense, size=None))
+        return Tensor(dense)
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ (y._array if isinstance(y, Tensor) else y)
+        return Tensor(out)
+    return Tensor(jnp.matmul(x._array, y._array))
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        bt = jsparse.bcoo_transpose(x._bcoo, permutation=tuple(perm))
+        return SparseCooTensor(bt)
+    return Tensor(jnp.transpose(x._array, perm))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    xd = x.to_dense()._array if isinstance(x, SparseCooTensor) else x._array
+    return Tensor(jnp.sum(xd, axis=axis, keepdims=keepdim))
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

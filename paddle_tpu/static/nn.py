@@ -1,0 +1,57 @@
+"""paddle.static.nn parity — functional layer builders routed to nn.functional.
+
+Reference analog: python/paddle/static/nn/common.py (fc, conv2d, ...). These
+exist so static-style model code ports; they construct ephemeral Layers.
+"""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn import Linear, Conv2D, BatchNorm, Embedding
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_features *= d
+    from ..tensor.manipulation import reshape
+    flat = reshape(x, list(x.shape[:num_flatten_dims]) + [in_features])
+    layer = Linear(in_features, size, weight_attr, bias_attr)
+    out = layer(flat)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    in_channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = Conv2D(in_channels, num_filters, filter_size, stride, padding,
+                   dilation, groups, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               **kwargs):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = BatchNorm(c, momentum, epsilon, param_attr, bias_attr,
+                      data_layout)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
+              param_attr=None, dtype="float32"):
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(input)

@@ -1,0 +1,180 @@
+"""paddle_tpu.tensor — the tensor op surface.
+
+Reference analog: python/paddle/tensor/__init__.py plus the Tensor
+method-patching done by python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py: every public op is also installed as a Tensor
+method, and Python operators are overloaded.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor, apply_op
+from . import creation, math, logic, manipulation, linalg, search, random, \
+    attribute, einsum as einsum_mod
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .attribute import shape as shape_op, rank  # noqa: F401
+from .einsum import einsum  # noqa: F401
+
+from .math import (add, subtract, multiply, divide, floor_divide, mod, pow,
+                   neg, abs)  # noqa: A004
+from .logic import (equal, not_equal, greater_than, greater_equal, less_than,
+                    less_equal)
+from .manipulation import cast as _cast_fn
+
+
+# ---------------------------------------------------------------------------
+# Tensor method patching (math_op_patch analog)
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [creation, math, logic, manipulation, linalg, search,
+                   random, einsum_mod]
+
+# ops whose first arg isn't the tensor / that shouldn't become methods
+_SKIP_METHODS = {
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+    "logspace", "eye", "meshgrid", "tril_indices", "triu_indices",
+    "complex", "create_parameter", "rand", "randn", "randint", "randperm",
+    "uniform", "normal", "gaussian", "standard_normal", "scatter_nd",
+    "add_n", "multiplex", "broadcast_tensors", "multi_dot", "einsum",
+    "searchsorted", "concat", "stack", "where",
+}
+
+
+def _install_methods():
+    for modsrc in _METHOD_SOURCES:
+        for name in getattr(modsrc, "__all__", []):
+            if name in _SKIP_METHODS:
+                continue
+            fn = getattr(modsrc, name)
+            if callable(fn) and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    # aliases / special names
+    Tensor.astype = lambda self, dtype: _cast_fn(self, dtype)
+    Tensor.cast = _cast_fn
+    Tensor.dim = lambda self: self.ndim
+    Tensor.numel = lambda self: manipulation.numel(self)
+    Tensor.dot = linalg.dot
+    Tensor.matmul = linalg.matmul
+    Tensor.mm = linalg.matmul
+    Tensor.norm = linalg.norm
+    Tensor.where = lambda self, x, y: manipulation.where(self, x, y)
+    Tensor.add_ = lambda self, y: self._set_array(self._array + _arr(y))
+    Tensor.subtract_ = lambda self, y: self._set_array(self._array - _arr(y))
+    Tensor.multiply_ = lambda self, y: self._set_array(self._array * _arr(y))
+    Tensor.scale_ = lambda self, s=1.0, bias=0.0: self._set_array(
+        self._array * jnp.asarray(s, self._array.dtype)
+        + jnp.asarray(bias, self._array.dtype))
+    Tensor.zero_ = lambda self: self._set_array(jnp.zeros_like(self._array))
+    Tensor.fill_ = lambda self, v: self._set_array(
+        jnp.full_like(self._array, v))
+    Tensor.clip_ = lambda self, min=None, max=None: self._set_array(
+        jnp.clip(self._array, min, max))
+    Tensor.exponential_ = random.exponential_
+    Tensor.uniform_ = random.uniform_
+    Tensor.normal_ = random.normal_
+    Tensor.scatter_ = manipulation.scatter_
+    Tensor.reshape_ = manipulation.reshape_
+    Tensor.fill_diagonal_ = manipulation.fill_diagonal_
+    Tensor.unbind = manipulation.unbind
+    Tensor.cpu = lambda self: self
+    Tensor.cuda = lambda self: self
+    Tensor.tpu = lambda self: self
+    Tensor.pin_memory = lambda self: self
+    Tensor.contiguous = lambda self: self
+    Tensor.is_contiguous = lambda self: True
+
+
+def _arr(y):
+    return y._array if isinstance(y, Tensor) else y
+
+
+def _binop(fn, reverse=False):
+    def method(self, other):
+        if reverse:
+            return fn(to_tensor(other) if not isinstance(other, Tensor)
+                      else other, self)
+        return fn(self, other)
+    return method
+
+
+def _install_operators():
+    Tensor.__add__ = _binop(add)
+    Tensor.__radd__ = _binop(add, reverse=True)
+    Tensor.__sub__ = _binop(subtract)
+    Tensor.__rsub__ = _binop(subtract, reverse=True)
+    Tensor.__mul__ = _binop(multiply)
+    Tensor.__rmul__ = _binop(multiply, reverse=True)
+    Tensor.__truediv__ = _binop(divide)
+    Tensor.__rtruediv__ = _binop(divide, reverse=True)
+    Tensor.__floordiv__ = _binop(floor_divide)
+    Tensor.__rfloordiv__ = _binop(floor_divide, reverse=True)
+    Tensor.__mod__ = _binop(mod)
+    Tensor.__rmod__ = _binop(mod, reverse=True)
+    Tensor.__pow__ = _binop(pow)
+    Tensor.__rpow__ = _binop(pow, reverse=True)
+    Tensor.__matmul__ = _binop(linalg.matmul)
+    Tensor.__rmatmul__ = _binop(linalg.matmul, reverse=True)
+    Tensor.__neg__ = lambda self: neg(self)
+    Tensor.__abs__ = lambda self: abs(self)
+    Tensor.__eq__ = _binop(equal)
+    Tensor.__ne__ = _binop(not_equal)
+    Tensor.__lt__ = _binop(less_than)
+    Tensor.__le__ = _binop(less_equal)
+    Tensor.__gt__ = _binop(greater_than)
+    Tensor.__ge__ = _binop(greater_equal)
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__and__ = _binop(_and)
+    Tensor.__or__ = _binop(_or)
+    Tensor.__xor__ = _binop(_xor)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+
+def _and(x, y):
+    if jnp.dtype(x.dtype) == jnp.bool_:
+        return logic.logical_and(x, y)
+    return math.bitwise_and(x, y)
+
+
+def _or(x, y):
+    if jnp.dtype(x.dtype) == jnp.bool_:
+        return logic.logical_or(x, y)
+    return math.bitwise_or(x, y)
+
+
+def _xor(x, y):
+    if jnp.dtype(x.dtype) == jnp.bool_:
+        return logic.logical_xor(x, y)
+    return math.bitwise_xor(x, y)
+
+
+def _idx_conv(item):
+    if isinstance(item, Tensor):
+        return item._array
+    if isinstance(item, tuple):
+        return tuple(_idx_conv(i) for i in item)
+    if isinstance(item, list):
+        return jnp.asarray(item)
+    return item
+
+
+def _getitem(self, item):
+    idx = _idx_conv(item)
+    return apply_op(lambda a: a[idx], self, op_name="getitem")
+
+
+def _setitem(self, item, value):
+    idx = _idx_conv(item)
+    v = value._array if isinstance(value, Tensor) else value
+    self._set_array(self._array.at[idx].set(v))
+
+
+_install_methods()
+_install_operators()

@@ -1,0 +1,42 @@
+"""Tensor attribute ops.
+
+Reference analog: python/paddle/tensor/attribute.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = ["shape", "rank", "is_floating_point", "is_integer", "is_complex",
+           "real", "imag"]
+
+
+def shape(x):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def rank(x):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return dtype_mod.is_floating_point(_ensure_tensor(x).dtype)
+
+
+def is_integer(x):
+    return dtype_mod.is_integer(_ensure_tensor(x).dtype)
+
+
+def is_complex(x):
+    return dtype_mod.is_complex(_ensure_tensor(x).dtype)
+
+
+from .math import real, imag  # noqa: E402  (re-export for paddle parity)
+
+for _n in ["shape", "rank"]:
+    register(_n, globals()[_n])

@@ -1,0 +1,225 @@
+"""Tensor creation ops.
+
+Reference analog: python/paddle/tensor/creation.py (full_like/ones/zeros/
+arange/linspace/eye/empty/tril/triu/meshgrid/diag/...), lowered to jnp
+instead of fill_constant-family PHI kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+from ..core import dtype as dtype_mod
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "tril", "triu", "meshgrid", "diag", "diagflat", "diag_embed",
+    "assign", "clone", "tril_indices", "triu_indices", "complex",
+    "create_parameter",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._array) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default or dtype_mod.get_default_dtype()
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.zeros_like(x._array, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.ones_like(x._array, dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.full_like(x._array, fill_value,
+                                dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = jnp.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_dt(dtype, jnp.dtype(jnp.float32))))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_dt(dtype, jnp.dtype(jnp.float32))))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, diagonal), _ensure_tensor(x),
+                    op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, diagonal), _ensure_tensor(x),
+                    op_name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    tensors = [_ensure_tensor(a) for a in args]
+    outs = apply_op(lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")),
+                    *tensors, op_name="meshgrid")
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = _ensure_tensor(x)
+
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=jnp.bool_), k=offset)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, a.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply_op(_diag, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), x, op_name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = _ensure_tensor(x)
+
+    def _emb(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        out = base.at[..., rows, cols].set(a)
+        if (dim1, dim2) not in ((-2, -1), (a.ndim - 1, a.ndim)):
+            perm = list(range(out.ndim - 2))
+            perm.insert(dim1 if dim1 >= 0 else out.ndim + dim1, out.ndim - 2)
+            perm.insert(dim2 if dim2 >= 0 else out.ndim + dim2, out.ndim - 1)
+        return out
+    return apply_op(_emb, x, op_name="diag_embed")
+
+
+def assign(x, output=None):
+    x = _ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, int, float, bool)) else to_tensor(x)
+    out = apply_op(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.number) else a,
+                   x, op_name="assign")
+    if output is not None:
+        output._set_array(out._array)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return _ensure_tensor(x).clone()
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, jnp.dtype(jnp.int32))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype, jnp.dtype(jnp.int32))))
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    return apply_op(lambda r, i: jax_lax_complex(r, i), _ensure_tensor(real),
+                    _ensure_tensor(imag), op_name="complex")
+
+
+def jax_lax_complex(r, i):
+    import jax.lax as lax
+    return lax.complex(r, i)
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter parity; returns a trainable leaf Tensor."""
+    from ..nn.initializer import _resolve_initializer
+    init = _resolve_initializer(attr, default_initializer, is_bias)
+    arr = init(_shape_list(shape), _dt(dtype))
+    t = Tensor(arr, stop_gradient=False)
+    t.is_leaf_param = True
+    t.persistable = True
+    if name:
+        t.name = name
+    return t
+
+
+for _n in __all__:
+    if _n not in ("to_tensor",):
+        register(_n, globals()[_n])

@@ -1,0 +1,23 @@
+"""Einsum.
+
+Reference analog: python/paddle/tensor/einsum.py (own planner over matmul/
+reduce ops). Here it is jnp.einsum — XLA's dot_general handles the
+contraction planning and MXU mapping.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply_op
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    tensors = [_ensure_tensor(op) for op in operands]
+    return apply_op(lambda *arrs: jnp.einsum(equation, *arrs), *tensors,
+                    op_name="einsum")
+
+
+register("einsum", einsum)

@@ -1,0 +1,294 @@
+"""Linear algebra ops.
+
+Reference analog: python/paddle/tensor/linalg.py (matmul at :137) with PHI
+kernels over cuBLAS/cuSOLVER (paddle/phi/kernels/funcs/blas). Here matmul is
+jnp.matmul — XLA lowers it straight onto the MXU with bf16/f32 accumulate —
+and decompositions come from jnp.linalg (lowered to XLA's QR/SVD/Cholesky).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "matmul", "bmm", "dot", "mv", "t", "norm", "dist", "cond", "cross",
+    "cholesky", "cholesky_solve", "qr", "svd", "inv", "det", "slogdet",
+    "solve", "triangular_solve", "eig", "eigh", "eigvals", "eigvalsh",
+    "matrix_power", "matrix_rank", "pinv", "lstsq", "lu", "multi_dot",
+    "corrcoef", "cov", "householder_product", "matrix_transpose",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """paddle.matmul parity (python/paddle/tensor/linalg.py:137)."""
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+
+    def _f(a, b):
+        if transpose_x:
+            if a.ndim == 1:
+                pass
+            else:
+                a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            if b.ndim == 1:
+                pass
+            else:
+                b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return apply_op(_f, x, y, op_name="matmul")
+
+
+def bmm(x, y, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(jnp.matmul, x, y, op_name="bmm")
+
+
+def dot(x, y, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot")
+
+
+def mv(x, vec, name=None):
+    x, vec = _ensure_tensor(x), _ensure_tensor(vec)
+    return apply_op(jnp.matmul, x, vec, op_name="mv")
+
+
+def t(x, name=None):
+    x = _ensure_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim<=2; use transpose")
+    return apply_op(lambda a: a.T if a.ndim == 2 else a, x, op_name="t")
+
+
+def matrix_transpose(x, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.swapaxes(a, -1, -2), x, op_name="matrix_transpose")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    if p is None:
+        p = 2 if axis is not None or True else "fro"
+
+    def _f(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p in ("fro", 2, 2.0):
+                return jnp.sqrt(jnp.sum(flat * flat)) if not keepdim else \
+                    jnp.sqrt(jnp.sum(flat * flat)).reshape([1] * a.ndim)
+            if p in ("inf", jnp.inf, float("inf")):
+                return jnp.max(jnp.abs(flat))
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if isinstance(ax, tuple) or p == "fro":
+            return jnp.linalg.norm(a, ord="fro" if p == "fro" else p,
+                                   axis=ax, keepdims=keepdim)
+        if p in ("inf", jnp.inf, float("inf")):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p in ("-inf", -jnp.inf, float("-inf")):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op(_f, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+
+    def _f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op(_f, x, y, op_name="dist")
+
+
+def cond(x, p=None, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.linalg.cond(a, p=p), x, op_name="cond")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    if axis == 9:  # paddle default: first axis of length 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=axis), x, y,
+                    op_name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        lo = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(lo, -1, -2) if upper else lo
+    return apply_op(_f, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+
+    def _f(b, chol):
+        import jax.scipy.linalg as jsl
+        return jsl.cho_solve((chol, not upper), b)
+    return apply_op(_f, x, y, op_name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    x = _ensure_tensor(x)
+    q, r = apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x,
+                    op_name="qr")
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    x = _ensure_tensor(x)
+    outs = apply_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        x, op_name="svd")
+    return outs
+
+
+def inv(x, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(jnp.linalg.inv, x, op_name="inv")
+
+
+def det(x, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    x = _ensure_tensor(x)
+    outs = apply_op(lambda a: tuple(jnp.linalg.slogdet(a)), x,
+                    op_name="slogdet")
+    from .manipulation import stack
+    return stack(list(outs), axis=0)
+
+
+def solve(x, y, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+
+    def _f(a, b):
+        import jax.scipy.linalg as jsl
+        return jsl.solve_triangular(a, b, lower=not upper,
+                                    trans=1 if transpose else 0,
+                                    unit_diagonal=unitriangular)
+    return apply_op(_f, x, y, op_name="triangular_solve")
+
+
+def eig(x, name=None):
+    import numpy as np
+    x = _ensure_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._array))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = _ensure_tensor(x)
+    outs = apply_op(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)),
+                    x, op_name="eigh")
+    return outs
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    x = _ensure_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._array))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = _ensure_tensor(x)
+    return apply_op(jnp.linalg.eigvalsh, x, op_name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x,
+                    op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x,
+                    op_name="matrix_rank")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                              hermitian=hermitian), x,
+                    op_name="pinv")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    outs = apply_op(lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+                    x, y, op_name="lstsq")
+    return outs
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    x = _ensure_tensor(x)
+    lu_, piv = apply_op(lambda a: tuple(jsl.lu_factor(a)), x, op_name="lu")
+    if get_infos:
+        from .creation import zeros
+        return lu_, piv, zeros([1], dtype="int32")
+    return lu_, piv
+
+
+def multi_dot(x, name=None):
+    tensors = [_ensure_tensor(t) for t in x]
+    return apply_op(lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors,
+                    op_name="multi_dot")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x,
+                    op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.cov(a, rowvar=rowvar,
+                                      ddof=1 if ddof else 0), x, op_name="cov")
+
+
+def householder_product(x, tau, name=None):
+    x, tau = _ensure_tensor(x), _ensure_tensor(tau)
+
+    def _f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else eye
+        for i in range(n - 1, -1, -1):
+            v = a[..., :, i]
+            mask = (jnp.arange(m) > i).astype(a.dtype)
+            v = v * mask + (jnp.arange(m) == i).astype(a.dtype)
+            vvt = jnp.einsum("...i,...j->...ij", v, v)
+            h = eye - t_[..., i][..., None, None] * vvt
+            q = jnp.matmul(h, q)
+        return q[..., :, :n] if False else q[..., :m, :n]
+    return apply_op(_f, x, tau, op_name="householder_product")
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

@@ -1,0 +1,65 @@
+"""Comparison / logical ops.
+
+Reference analog: python/paddle/tensor/logic.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import binary_op, unary_op, register, _ensure_tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "isclose", "allclose", "equal_all", "is_empty", "is_tensor",
+]
+
+equal = binary_op("equal", jnp.equal)
+not_equal = binary_op("not_equal", jnp.not_equal)
+greater_than = binary_op("greater_than", jnp.greater)
+greater_equal = binary_op("greater_equal", jnp.greater_equal)
+less_than = binary_op("less_than", jnp.less)
+less_equal = binary_op("less_equal", jnp.less_equal)
+logical_and = binary_op("logical_and", jnp.logical_and)
+logical_or = binary_op("logical_or", jnp.logical_or)
+logical_xor = binary_op("logical_xor", jnp.logical_xor)
+logical_not = unary_op("logical_not", jnp.logical_not)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                    x, y, op_name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan),
+                    x, y, op_name="allclose")
+
+
+def equal_all(x, y, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(
+        lambda a, b: jnp.asarray(a.shape == b.shape and bool_all(a, b)),
+        x, y, op_name="equal_all")
+
+
+def bool_all(a, b):
+    return jnp.all(a == b) if a.shape == b.shape else jnp.asarray(False)
+
+
+def is_empty(x, name=None):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+for _n in ["isclose", "allclose", "equal_all", "is_empty"]:
+    register(_n, globals()[_n])

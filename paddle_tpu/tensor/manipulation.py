@@ -1,0 +1,537 @@
+"""Shape/layout manipulation ops.
+
+Reference analog: python/paddle/tensor/manipulation.py (reshape/concat/
+split/gather/scatter/...), PHI kernels paddle/phi/kernels/*/concat_kernel*
+etc. All static-shape jnp lowerings so everything stays jit/MXU friendly.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
+    "concat", "stack", "split", "chunk", "unstack", "unbind", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip",
+    "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "index_put", "masked_select", "masked_fill", "where", "take_along_axis",
+    "put_along_axis", "cast", "slice", "pad", "repeat_interleave",
+    "moveaxis", "swapaxes", "as_complex", "as_real", "view", "view_as",
+    "atleast_1d", "atleast_2d", "atleast_3d", "unfold", "tensordot",
+    "numel", "shard_index", "crop", "fill_diagonal_",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(v) for v in shape.tolist()]
+    out = []
+    for s in shape:
+        out.append(int(s._array) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+def reshape(x, shape, name=None):
+    x = _ensure_tensor(x)
+    shape = _shape_list(shape)
+    # paddle semantics: 0 means "copy this dim from input"
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return apply_op(lambda a: jnp.reshape(a, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    from ..core.tensor import rebind_inplace, tape_snapshot
+    return rebind_inplace(x, reshape(tape_snapshot(x), shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x.shape[:s] + [-1] + x.shape[e + 1:]
+    return apply_op(lambda a: jnp.reshape(a, new_shape), x, op_name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op(_f, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    x = _ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._array) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def _f(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply_op(_f, x, op_name="unsqueeze")
+
+
+def transpose(x, perm, name=None):
+    x = _ensure_tensor(x)
+    perm = [int(p) for p in perm]
+    return apply_op(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), x,
+                    op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x,
+                    op_name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors,
+                    op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_ensure_tensor(t) for t in x]
+    return apply_op(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors,
+                    op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"paddle.split: dimension {dim} at axis {axis} is not "
+                f"divisible by num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_unknown = sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def _f(a):
+        return tuple(lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sizes))
+    return list(apply_op(_f, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _ensure_tensor(x)
+    n = num or x.shape[axis]
+
+    def _f(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(apply_op(_f, x, op_name="unstack"))
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    x = _ensure_tensor(x)
+    reps = _shape_list(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    x = _ensure_tensor(x)
+    shape = _shape_list(shape)
+    xs = x.shape
+    full = list(shape)
+    off = len(full) - len(xs)
+    for i, s in enumerate(full):
+        if s == -1:
+            full[i] = xs[i - off] if i >= off else 1
+    return apply_op(lambda a: jnp.broadcast_to(a, full), x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, _ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [_ensure_tensor(t) for t in inputs]
+    outs = apply_op(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)),
+                    *tensors, op_name="broadcast_tensors")
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    x = _ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x,
+                    op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), x, op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = _ensure_tensor(x), _ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i,
+                                          axis=axis), x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = _ensure_tensor(x), _ensure_tensor(index)
+
+    def _f(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx]
+    return apply_op(_f, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x = _ensure_tensor(x)
+    index = _ensure_tensor(index)
+    updates = _ensure_tensor(updates)
+
+    def _f(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply_op(_f, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from ..core.tensor import rebind_inplace, tape_snapshot
+    return rebind_inplace(x, scatter(tape_snapshot(x), index, updates,
+                                     overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index = _ensure_tensor(index)
+    updates = _ensure_tensor(updates)
+    shape = _shape_list(shape)
+
+    def _f(idx, upd):
+        z = jnp.zeros(shape, upd.dtype)
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return z.at[flat_idx].add(upd)
+    return apply_op(_f, index, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x = _ensure_tensor(x)
+    index = _ensure_tensor(index)
+    updates = _ensure_tensor(updates)
+
+    def _f(a, idx, upd):
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[flat_idx].add(upd)
+    return apply_op(_f, x, index, updates, op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = _ensure_tensor(x), _ensure_tensor(index)
+    return apply_op(lambda a, i: jnp.take(a, i, axis=axis), x, index,
+                    op_name="index_select")
+
+
+def index_sample(x, index):
+    x, index = _ensure_tensor(x), _ensure_tensor(index)
+    return apply_op(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+        x, index, op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = _ensure_tensor(x), _ensure_tensor(index), _ensure_tensor(value)
+
+    def _f(a, i, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[i].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(_f, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = _ensure_tensor(x)
+    value = _ensure_tensor(value)
+    idx_tensors = [_ensure_tensor(i) for i in indices]
+
+    def _f(a, v, *idxs):
+        if accumulate:
+            return a.at[tuple(idxs)].add(v)
+        return a.at[tuple(idxs)].set(v)
+    return apply_op(_f, x, value, *idx_tensors, op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic-shaped output: eager-only (not jit-safe), matches reference
+    # semantics; under jit use `where` instead.
+    x, mask = _ensure_tensor(x), _ensure_tensor(mask)
+    arr = np.asarray(x._array)[np.asarray(mask._array)]
+    return Tensor(jnp.asarray(arr), stop_gradient=x.stop_gradient)
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = _ensure_tensor(x), _ensure_tensor(mask)
+    v = value._array if isinstance(value, Tensor) else value
+    return apply_op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                    x, mask, op_name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = _ensure_tensor(condition)
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                    op_name="where")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = _ensure_tensor(arr), _ensure_tensor(indices)
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                    arr, indices, op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices = _ensure_tensor(arr), _ensure_tensor(indices)
+    values = _ensure_tensor(values)
+
+    def _f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        am = jnp.moveaxis(a, axis, 0)
+        im = jnp.moveaxis(i, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        other = tuple(jnp.indices(im.shape)[1:])
+        if reduce == "assign":
+            out = am.at[(im,) + other].set(vm)
+        elif reduce == "add":
+            out = am.at[(im,) + other].add(vm)
+        elif reduce in ("mul", "multiply"):
+            out = am.at[(im,) + other].multiply(vm)
+        else:
+            raise ValueError(f"unsupported reduce {reduce}")
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(_f, arr, indices, values, op_name="put_along_axis")
+
+
+def cast(x, dtype):
+    from ..core import dtype as dtype_mod
+    x = _ensure_tensor(x)
+    dt = dtype_mod.convert_dtype(dtype)
+    return apply_op(lambda a: a.astype(dt), x, op_name="cast")
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    x = _ensure_tensor(x)
+
+    def _v(s):
+        return int(s._array) if isinstance(s, Tensor) else int(s)
+
+    def _f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            n = a.shape[ax]
+            st_, en_ = _v(st), _v(en)
+            st_ = n + st_ if st_ < 0 else st_
+            en_ = n + en_ if en_ < 0 else en_
+            en_ = min(en_, n)
+            out = lax.slice_in_dim(out, st_, en_, axis=ax)
+        return out
+    return apply_op(_f, x, op_name="slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _ensure_tensor(x)
+    shape = _shape_list(shape)
+    offsets = [0] * x.ndim if offsets is None else _shape_list(offsets)
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    return apply_op(lambda a: lax.dynamic_slice(a, offsets, shape), x,
+                    op_name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = _ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle nn.functional semantics: pad applies to last len(pad)//2 dims
+        # ordered from the last spatial dim inward, honoring data_format.
+        k = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC / NDHWC / NLC
+            dims = list(range(1, 1 + k))
+        else:
+            dims = list(range(nd - k, nd))
+        for i, d in enumerate(reversed(dims)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def _f(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return apply_op(_f, x, op_name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = _ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._array)
+        arr = np.repeat(np.asarray(x._array), reps, axis=axis)
+        return Tensor(jnp.asarray(arr), stop_gradient=x.stop_gradient)
+    return apply_op(
+        lambda a: jnp.repeat(a.reshape(-1) if axis is None else a,
+                             repeats, axis=0 if axis is None else axis),
+        x, op_name="repeat_interleave")
+
+
+def as_complex(x, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: lax.complex(a[..., 0], a[..., 1]), x,
+                    op_name="as_complex")
+
+
+def as_real(x, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    x, op_name="as_real")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, _ensure_tensor(x), op_name="atleast_1d")
+            for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, _ensure_tensor(x), op_name="atleast_2d")
+            for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, _ensure_tensor(x), op_name="atleast_3d")
+            for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unfold(x, axis, size, step, name=None):
+    x = _ensure_tensor(x)
+    ax = axis % x.ndim
+
+    def _f(a):
+        n = a.shape[ax]
+        starts = jnp.arange(0, n - size + 1, step)
+        def one(s):
+            return lax.dynamic_slice_in_dim(a, s, size, axis=ax)
+        out = jax_vmap_stack(one, starts)       # [num, ..., size@ax+1, ...]
+        out = jnp.moveaxis(out, 0, ax)          # [..., num@ax, size@ax+1,..]
+        return jnp.moveaxis(out, ax + 1, -1)    # paddle: size appended last
+    return apply_op(_f, x, op_name="unfold")
+
+
+def jax_vmap_stack(fn, xs):
+    import jax
+    return jax.vmap(fn)(xs)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                    op_name="tensordot")
+
+
+def numel(x, name=None):
+    x = _ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    input = _ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def _f(a):
+        shard = a // shard_size
+        in_shard = shard == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+    return apply_op(_f, input, op_name="shard_index")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = _ensure_tensor(x)
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n - (offset if offset > 0 else 0))
+    arr = x._array.at[..., idx + max(-offset, 0), idx + max(offset, 0)].set(value)
+    x._set_array(arr)
+    return x
+
+
+for _n in __all__:
+    if _n not in ("reshape_", "view", "view_as"):
+        register(_n, globals()[_n])

@@ -1,0 +1,421 @@
+"""Math ops.
+
+Reference analog: python/paddle/tensor/math.py (plus ops.py activations),
+backed there by PHI elementwise/reduce kernels
+(paddle/phi/kernels/{cpu,gpu}/elementwise_*, reduce_*). Here every op is one
+jnp call; XLA fuses chains of them into single TPU kernels, which replaces
+the reference's hand-fused elementwise machinery.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import unary_op, binary_op, register, _ensure_tensor
+
+__all__ = [
+    # elementwise unary
+    "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "square", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv", "floor",
+    "ceil", "round", "trunc", "frac", "sign", "sgn", "reciprocal",
+    "sigmoid", "logit", "digamma", "lgamma", "angle", "conj", "real",
+    "imag", "deg2rad", "rad2deg", "i0", "isnan", "isinf", "isfinite",
+    # elementwise binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "floor_mod", "pow", "maximum", "minimum", "fmax", "fmin",
+    "atan2", "hypot", "heaviside", "copysign", "nextafter", "logaddexp",
+    "gcd", "lcm", "ldexp", "inner", "outer", "kron",
+    # bitwise
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+    # reductions / scans
+    "sum", "mean", "prod", "nansum", "nanmean", "max", "min", "amax",
+    "amin", "all", "any", "std", "var", "median", "quantile", "logsumexp",
+    "count_nonzero", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp",
+    # misc
+    "scale", "clip", "lerp", "add_n", "multiplex", "trace", "diagonal",
+    "diff", "stanh", "nan_to_num", "increment", "rsqrt_",
+]
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+abs = unary_op("abs", jnp.abs)  # noqa: A001
+neg = unary_op("neg", jnp.negative)
+exp = unary_op("exp", jnp.exp)
+expm1 = unary_op("expm1", jnp.expm1)
+log = unary_op("log", jnp.log)
+log2 = unary_op("log2", jnp.log2)
+log10 = unary_op("log10", jnp.log10)
+log1p = unary_op("log1p", jnp.log1p)
+sqrt = unary_op("sqrt", jnp.sqrt)
+rsqrt = unary_op("rsqrt", lax.rsqrt)
+square = unary_op("square", jnp.square)
+sin = unary_op("sin", jnp.sin)
+cos = unary_op("cos", jnp.cos)
+tan = unary_op("tan", jnp.tan)
+asin = unary_op("asin", jnp.arcsin)
+acos = unary_op("acos", jnp.arccos)
+atan = unary_op("atan", jnp.arctan)
+sinh = unary_op("sinh", jnp.sinh)
+cosh = unary_op("cosh", jnp.cosh)
+tanh = unary_op("tanh", jnp.tanh)
+asinh = unary_op("asinh", jnp.arcsinh)
+acosh = unary_op("acosh", jnp.arccosh)
+atanh = unary_op("atanh", jnp.arctanh)
+erf = unary_op("erf", lax.erf)
+erfinv = unary_op("erfinv", lax.erf_inv)
+floor = unary_op("floor", jnp.floor)
+ceil = unary_op("ceil", jnp.ceil)
+round = unary_op("round", jnp.round)  # noqa: A001
+trunc = unary_op("trunc", jnp.trunc)
+frac = unary_op("frac", lambda x: x - jnp.trunc(x))
+sign = unary_op("sign", jnp.sign)
+sgn = unary_op("sgn", jnp.sign)
+reciprocal = unary_op("reciprocal", jnp.reciprocal)
+sigmoid = unary_op("sigmoid", jax_sigmoid := lambda x: lax.logistic(x))
+logit = unary_op("logit", lambda x: jnp.log(x / (1 - x)))
+digamma = unary_op("digamma", lax.digamma)
+lgamma = unary_op("lgamma", lax.lgamma)
+angle = unary_op("angle", jnp.angle)
+conj = unary_op("conj", jnp.conj)
+real = unary_op("real", jnp.real)
+imag = unary_op("imag", jnp.imag)
+deg2rad = unary_op("deg2rad", jnp.deg2rad)
+rad2deg = unary_op("rad2deg", jnp.rad2deg)
+i0 = unary_op("i0", lambda x: jnp.i0(x))
+isnan = unary_op("isnan", jnp.isnan)
+isinf = unary_op("isinf", jnp.isinf)
+isfinite = unary_op("isfinite", jnp.isfinite)
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+add = binary_op("add", jnp.add)
+subtract = binary_op("subtract", jnp.subtract)
+multiply = binary_op("multiply", jnp.multiply)
+divide = binary_op("divide", jnp.true_divide)
+floor_divide = binary_op("floor_divide", jnp.floor_divide)
+mod = binary_op("mod", jnp.mod)
+remainder = binary_op("remainder", jnp.remainder)
+floor_mod = remainder
+pow = binary_op("pow", jnp.power)  # noqa: A001
+maximum = binary_op("maximum", jnp.maximum)
+minimum = binary_op("minimum", jnp.minimum)
+fmax = binary_op("fmax", jnp.fmax)
+fmin = binary_op("fmin", jnp.fmin)
+atan2 = binary_op("atan2", jnp.arctan2)
+hypot = binary_op("hypot", jnp.hypot)
+heaviside = binary_op("heaviside", jnp.heaviside)
+copysign = binary_op("copysign", jnp.copysign)
+nextafter = binary_op("nextafter", jnp.nextafter)
+logaddexp = binary_op("logaddexp", jnp.logaddexp)
+gcd = binary_op("gcd", jnp.gcd)
+lcm = binary_op("lcm", jnp.lcm)
+ldexp = binary_op("ldexp", jnp.ldexp)
+inner = binary_op("inner", jnp.inner)
+outer = binary_op("outer", jnp.outer)
+kron = binary_op("kron", jnp.kron)
+
+# bitwise
+bitwise_and = binary_op("bitwise_and", jnp.bitwise_and)
+bitwise_or = binary_op("bitwise_or", jnp.bitwise_or)
+bitwise_xor = binary_op("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = unary_op("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = binary_op("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = binary_op("bitwise_right_shift", jnp.right_shift)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduction(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = _ensure_tensor(x)
+        kw = {}
+        if dtype is not None:
+            from ..core import dtype as dtype_mod
+            kw["dtype"] = dtype_mod.convert_dtype(dtype)
+        return apply_op(
+            lambda a: jfn(a, axis=_axis(axis), keepdims=keepdim, **kw),
+            x, op_name=name or op.__name__)
+    op.__name__ = name
+    register(name, op)
+    return op
+
+
+sum = _reduction("sum", jnp.sum)  # noqa: A001
+mean = _reduction("mean", jnp.mean)
+prod = _reduction("prod", jnp.prod)
+nansum = _reduction("nansum", jnp.nansum)
+nanmean = _reduction("nanmean", jnp.nanmean)
+
+
+def _cmp_reduction(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = _ensure_tensor(x)
+        return apply_op(lambda a: jfn(a, axis=_axis(axis), keepdims=keepdim),
+                        x, op_name=name or op.__name__)
+    op.__name__ = name
+    register(name, op)
+    return op
+
+
+max = _cmp_reduction("max", jnp.max)  # noqa: A001
+min = _cmp_reduction("min", jnp.min)  # noqa: A001
+amax = _cmp_reduction("amax", jnp.max)
+amin = _cmp_reduction("amin", jnp.min)
+all = _cmp_reduction("all", jnp.all)  # noqa: A001
+any = _cmp_reduction("any", jnp.any)  # noqa: A001
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    return apply_op(lambda a: jnp.std(a, axis=_axis(axis), ddof=ddof,
+                                      keepdims=keepdim), x, op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    return apply_op(lambda a: jnp.var(a, axis=_axis(axis), ddof=ddof,
+                                      keepdims=keepdim), x, op_name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim),
+                    x, op_name="median")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis(axis),
+                                           keepdims=keepdim, method=interpolation),
+                    x, op_name="quantile")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    import jax.scipy.special as jsp
+    return apply_op(lambda a: jsp.logsumexp(a, axis=_axis(axis), keepdims=keepdim),
+                    x, op_name="logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.count_nonzero(a, axis=_axis(axis),
+                                                keepdims=keepdim).astype(jnp.int64),
+                    x, op_name="count_nonzero")
+
+
+# ---------------------------------------------------------------------------
+# scans
+# ---------------------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+    return apply_op(_f, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1))
+        return jnp.cumprod(a, axis=int(dim))
+    return apply_op(_f, x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        ax = 0 if axis is None else int(axis)
+        aa = a.reshape(-1) if axis is None else a
+        vals = lax.associative_scan(jnp.maximum, aa, axis=ax)
+        n = aa.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == (ax % aa.ndim) else 1
+                                     for i in range(aa.ndim)])
+        idx = jnp.broadcast_to(idx, aa.shape)
+        eq = aa == vals
+        inds = lax.associative_scan(jnp.maximum, jnp.where(eq, idx, -1), axis=ax)
+        return vals, inds.astype(jnp.int64)
+    return apply_op(_f, x, op_name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        ax = 0 if axis is None else int(axis)
+        aa = a.reshape(-1) if axis is None else a
+        vals = lax.associative_scan(jnp.minimum, aa, axis=ax)
+        n = aa.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == (ax % aa.ndim) else 1
+                                     for i in range(aa.ndim)])
+        idx = jnp.broadcast_to(idx, aa.shape)
+        eq = aa == vals
+        inds = lax.associative_scan(jnp.maximum, jnp.where(eq, idx, -1), axis=ax)
+        return vals, inds.astype(jnp.int64)
+    return apply_op(_f, x, op_name="cummin")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        ax = 0 if axis is None else int(axis)
+        aa = a.reshape(-1) if axis is None else a
+        return lax.associative_scan(jnp.logaddexp, aa, axis=ax)
+    return apply_op(_f, x, op_name="logcumsumexp")
+
+
+# ---------------------------------------------------------------------------
+# misc math
+# ---------------------------------------------------------------------------
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = _ensure_tensor(x)
+    s = scale._array if isinstance(scale, Tensor) else scale
+
+    def _f(a):
+        if bias_after_scale:
+            out = a * jnp.asarray(s, a.dtype) + jnp.asarray(bias, a.dtype)
+        else:
+            out = (a + jnp.asarray(bias, a.dtype)) * jnp.asarray(s, a.dtype)
+        return out
+    out = apply_op(_f, x, op_name="scale")
+    if act == "relu":
+        return apply_op(lambda a: jnp.maximum(a, 0), out, op_name="relu")
+    return out
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    x = _ensure_tensor(x)
+    mn = min._array if isinstance(min, Tensor) else min
+    mx = max._array if isinstance(max, Tensor) else max
+    return apply_op(lambda a: jnp.clip(a, mn, mx), x, op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight,
+                        op_name="lerp")
+    return apply_op(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    tensors = [_ensure_tensor(t) for t in inputs]
+    return apply_op(lambda *arrs: np_functools_reduce_add(arrs), *tensors,
+                    op_name="add_n")
+
+
+def np_functools_reduce_add(arrs):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + a
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    tensors = [_ensure_tensor(t) for t in inputs]
+    index = _ensure_tensor(index)
+
+    def _f(idx, *arrs):
+        stacked = jnp.stack(arrs, axis=0)
+        sel = idx.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(stacked.shape[1])]
+    return apply_op(_f, index, *tensors, op_name="multiplex")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), x, op_name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                           axis2=axis2), x, op_name="diagonal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = _ensure_tensor(x)
+    extra = []
+    if prepend is not None:
+        extra.append(_ensure_tensor(prepend))
+    if append is not None:
+        extra.append(_ensure_tensor(append))
+
+    def _f(a, *rest):
+        i = 0
+        pre = app = None
+        if prepend is not None:
+            pre = rest[i]; i += 1
+        if append is not None:
+            app = rest[i]
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    return apply_op(_f, x, *extra, op_name="diff")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = _ensure_tensor(x)
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                             neginf=neginf), x,
+                    op_name="nan_to_num")
+
+
+def increment(x, value=1.0, name=None):
+    from ..core.tensor import rebind_inplace, tape_snapshot
+    x = _ensure_tensor(x)
+    out = apply_op(lambda a: a + jnp.asarray(value, a.dtype),
+                   tape_snapshot(x), op_name="increment")
+    return rebind_inplace(x, out)
+
+
+def rsqrt_(x):
+    return _inplace(x, lambda a: lax.rsqrt(a))
+
+
+def _inplace(x, f):
+    x._set_array(f(x._array))
+    return x
+
+
+for _n in ["std", "var", "median", "quantile", "logsumexp", "cumsum",
+           "cumprod", "cummax", "cummin", "logcumsumexp", "scale", "clip",
+           "lerp", "add_n", "multiplex", "trace", "diagonal", "diff",
+           "stanh", "nan_to_num", "increment", "count_nonzero"]:
+    register(_n, globals()[_n])

@@ -1,0 +1,157 @@
+"""Random sampling ops.
+
+Reference analog: python/paddle/tensor/random.py (gaussian/uniform/randint/
+randperm/multinomial/bernoulli/...). Keys come from the global Generator
+(paddle_tpu.framework.random); under jit these ops bake the key drawn at
+trace time — for traced training loops use nn.functional.dropout's seeded
+path or pass explicit keys.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+from ..framework.random import next_key
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "gaussian", "standard_normal", "poisson", "bernoulli",
+    "multinomial", "exponential_", "uniform_", "normal_", "rand_like",
+    "randn_like",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(v) for v in shape.tolist()]
+    if isinstance(shape, int):
+        return [shape]
+    return [int(s._array) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else (default or dtype_mod.get_default_dtype())
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    dt = _dt(dtype)
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    arr = jax.random.normal(key, _shape_list(shape), dtype=dt) * std + mean
+    return Tensor(arr)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._array if isinstance(mean, Tensor) else mean
+        s = std._array if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        arr = jax.random.normal(next_key(), shp) * s + m
+        return Tensor(arr)
+    return gaussian(shape if shape is not None else [1], mean, std)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype=dtype)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    dt = _dt(dtype)
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    arr = jax.random.uniform(key, _shape_list(shape), dtype=dt,
+                             minval=min, maxval=max)
+    return Tensor(arr)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dt(dtype, jnp.dtype(jnp.int32))
+    arr = jax.random.randint(next_key(), _shape_list(shape), low, high,
+                             dtype=jnp.int32).astype(dt)
+    return Tensor(arr)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = _ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    arr = jax.random.permutation(next_key(), n)
+    return Tensor(arr.astype(_dt(dtype, jnp.dtype(jnp.int64))))
+
+
+def poisson(x, name=None):
+    x = _ensure_tensor(x)
+    arr = jax.random.poisson(next_key(), x._array).astype(x._array.dtype)
+    return Tensor(arr)
+
+
+def bernoulli(x, name=None):
+    x = _ensure_tensor(x)
+    arr = jax.random.bernoulli(next_key(), x._array).astype(x._array.dtype)
+    return Tensor(arr)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = _ensure_tensor(x)
+    a = x._array
+    p = a / jnp.sum(a, axis=-1, keepdims=True)
+    key = next_key()
+    if a.ndim == 1:
+        out = jax.random.choice(key, a.shape[-1], (num_samples,),
+                                replace=replacement, p=p)
+    else:
+        keys = jax.random.split(key, a.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, a.shape[-1], (num_samples,),
+                              replace=replacement, p=pi)
+            for k, pi in zip(keys, p)])
+    return Tensor(out.astype(jnp.int64))
+
+
+def rand_like(x, dtype=None, name=None):
+    x = _ensure_tensor(x)
+    return uniform(x.shape, dtype or x.dtype, 0.0, 1.0)
+
+
+def randn_like(x, dtype=None, name=None):
+    x = _ensure_tensor(x)
+    return gaussian(x.shape, dtype=dtype or x.dtype)
+
+
+# in-place variants (Tensor methods)
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x._set_array(jax.random.uniform(next_key(), x._array.shape,
+                                    dtype=x._array.dtype, minval=min,
+                                    maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._set_array(jax.random.normal(next_key(), x._array.shape,
+                                   dtype=x._array.dtype) * std + mean)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._set_array(jax.random.exponential(next_key(), x._array.shape,
+                                        dtype=x._array.dtype) / lam)
+    return x
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

@@ -1,0 +1,201 @@
+"""Search / sort ops.
+
+Reference analog: python/paddle/tensor/search.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "searchsorted",
+    "kthvalue", "mode", "unique", "unique_consecutive", "bucketize",
+    "histogram", "bincount",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(jnp.int64)
+    return apply_op(_f, x, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(jnp.int64)
+    return apply_op(_f, x, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        idx = jnp.argsort(a, axis=axis, stable=True,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return apply_op(_f, x, op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        out = jnp.sort(a, axis=axis, stable=True, descending=descending)
+        return out
+    return apply_op(_f, x, op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    x = _ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _f(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = lax.top_k(moved, k)
+        else:
+            vals, idx = lax.top_k(-moved, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply_op(_f, x, op_name="topk")
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic-shape: eager-only, like reference's dynamic-output ops.
+    x = _ensure_tensor(x)
+    idx = np.nonzero(np.asarray(x._array))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64)))
+                     for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, v = _ensure_tensor(sorted_sequence), _ensure_tensor(values)
+
+    def _f(s, x):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, x, side=side)
+        else:
+            import jax
+            flat_s = s.reshape(-1, s.shape[-1])
+            flat_x = x.reshape(-1, x.shape[-1])
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                flat_s, flat_x).reshape(x.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_op(_f, ss, v, op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        ax = axis % a.ndim
+        vals = jnp.sort(a, axis=ax)
+        idxs = jnp.argsort(a, axis=ax, stable=True)
+        v = jnp.take(vals, k - 1, axis=ax)
+        i = jnp.take(idxs, k - 1, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int64)
+    return apply_op(_f, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = _ensure_tensor(x)
+    arr = np.asarray(x._array)
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shp = moved.shape[:-1]
+    v, ind = vals.reshape(shp), idxs.reshape(shp)
+    if keepdim:
+        v, ind = np.expand_dims(v, ax), np.expand_dims(ind, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(ind))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = _ensure_tensor(x)
+    arr = np.asarray(x._array)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = _ensure_tensor(x)
+    arr = np.asarray(x._array)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        diff = np.any(arr[1:] != arr[:-1],
+                      axis=tuple(i for i in range(arr.ndim) if i != axis))
+        keep = np.concatenate([[True], diff])
+    out = arr[keep] if axis is None else np.compress(keep, arr, axis=axis)
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.where(keep)[0]
+        counts = np.diff(np.append(idx, len(keep)))
+        rets.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    input = _ensure_tensor(input)
+    arr = np.asarray(input._array)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _ensure_tensor(x)
+    w = _ensure_tensor(weights) if weights is not None else None
+    arr = np.asarray(x._array)
+    wa = np.asarray(w._array) if w is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, weights=wa,
+                                          minlength=minlength)))
+
+
+for _n in __all__:
+    register(_n, globals()[_n])

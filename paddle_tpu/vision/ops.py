@@ -1,0 +1,128 @@
+"""Vision ops (reference: python/paddle/vision/ops.py + operators/detection).
+
+Round-1 subset: nms, box conversion, roi_align (vectorized bilinear), yolo
+boxes deferred.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..ops.registry import register, _ensure_tensor
+
+__all__ = ["nms", "box_iou", "roi_align", "deform_conv2d"]
+
+
+def box_iou(boxes1, boxes2):
+    b1 = np.asarray(_ensure_tensor(boxes1)._array)
+    b2 = np.asarray(_ensure_tensor(boxes2)._array)
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = np.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = np.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return Tensor(jnp.asarray(inter / np.maximum(union, 1e-10)))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS — host-side (dynamic output), like the reference op."""
+    b = np.asarray(_ensure_tensor(boxes)._array)
+    if scores is None:
+        s = np.ones(len(b), np.float32)
+    else:
+        s = np.asarray(_ensure_tensor(scores)._array)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        w = np.clip(xx2 - xx1, 0, None)
+        h = np.clip(yy2 - yy1, 0, None)
+        inter = w * h
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = False
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    x = _ensure_tensor(x)
+    boxes = _ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bn = np.asarray(_ensure_tensor(boxes_num)._array)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+
+    def _f(feat, bxs):
+        n_roi = bxs.shape[0]
+        c = feat.shape[1]
+        h, w = feat.shape[2], feat.shape[3]
+        off = 0.5 if aligned else 0.0
+        x1 = bxs[:, 0] * spatial_scale - off
+        y1 = bxs[:, 1] * spatial_scale - off
+        x2 = bxs[:, 2] * spatial_scale - off
+        y2 = bxs[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (rh / oh)[:, None]
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (rw / ow)[:, None]
+
+        def bilinear(fmap, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v00 = fmap[:, y0][:, :, x0]
+            # vectorized gather per roi handled below instead
+            return None
+
+        outs = []
+        for r in range(n_roi):
+            fmap = feat[batch_idx[r]]  # [C,H,W]
+            yy = ys[r][:, None]  # [oh,1]
+            xx = xs[r][None, :]  # [1,ow]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            g = lambda yi, xi: fmap[:, yi.squeeze(-1) if yi.ndim > 2 else yi,
+                                    :][:, :, xi.squeeze(0) if xi.ndim > 2
+                                       else xi]
+            v00 = fmap[:, y0[:, 0]][:, :, x0[0, :]]
+            v01 = fmap[:, y0[:, 0]][:, :, x1_[0, :]]
+            v10 = fmap[:, y1_[:, 0]][:, :, x0[0, :]]
+            v11 = fmap[:, y1_[:, 0]][:, :, x1_[0, :]]
+            val = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                   + v10 * wy * (1 - wx) + v11 * wy * wx)
+            outs.append(val)
+        return jnp.stack(outs)
+    return apply_op(_f, x, boxes, op_name="roi_align")
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: planned (needs a gather-based Pallas kernel)")
+
+
+for _n in ["nms", "box_iou", "roi_align"]:
+    register(_n, globals()[_n])

@@ -1,0 +1,210 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/) —
+numpy-based host-side preprocessing (CHW float output convention)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "to_tensor", "normalize", "resize", "hflip",
+           "vflip", "center_crop", "crop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _as_np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._array)
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _as_np(pic).astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    from ..core.tensor import to_tensor as tt
+    return tt(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, pic):
+        return to_tensor(pic, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _as_np(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    if isinstance(img, Tensor):
+        from ..core.tensor import to_tensor as tt
+        return tt(out)
+    return out
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _as_np(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    import jax
+    import jax.numpy as jnp
+    out_shape = (size[0], size[1]) + arr.shape[2:]
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[interpolation]
+    out = jax.image.resize(jnp.asarray(arr.astype(np.float32)), out_shape,
+                           method=method)
+    return np.asarray(out).astype(arr.dtype)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def crop(img, top, left, height, width):
+    arr = _as_np(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    arr = _as_np(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = (h - th) // 2
+    left = (w - tw) // 2
+    return crop(arr, top, left, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        if self.padding:
+            p = self.padding
+            arr = np.pad(arr, [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = np.random.randint(0, h - th + 1)
+        left = np.random.randint(0, w - tw + 1)
+        return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_np(img)[::-1]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return _as_np(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _as_np(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = _as_np(img)
+        p = self.padding
+        if isinstance(p, int):
+            cfg = [(p, p), (p, p)]
+        elif len(p) == 2:
+            cfg = [(p[1], p[1]), (p[0], p[0])]
+        else:
+            cfg = [(p[1], p[3]), (p[0], p[2])]
+        cfg += [(0, 0)] * (arr.ndim - 2)
+        mode = {"constant": "constant", "edge": "edge",
+                "reflect": "reflect", "symmetric": "symmetric"}[self.mode]
+        if mode == "constant":
+            return np.pad(arr, cfg, mode=mode, constant_values=self.fill)
+        return np.pad(arr, cfg, mode=mode)

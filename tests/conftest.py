@@ -1,0 +1,31 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's hardware-free CI strategy (SURVEY.md §4: fake
+devices / Gloo-CPU fallback): all distributed tests run on
+xla_force_host_platform_device_count=8.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: axon may be preset in env
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# The axon sitecustomize pins jax_platforms to the TPU tunnel; tests run on
+# the virtual CPU mesh, so override via config (env alone is not enough).
+jax.config.update("jax_platforms", "cpu")
+# Matmuls default to MXU-style bf16 accumulate; numeric checks need full f32.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

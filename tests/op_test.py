@@ -1,0 +1,86 @@
+"""OpTest harness.
+
+Reference analog: python/paddle/fluid/tests/unittests/op_test.py:327 —
+declare an op + numpy inputs, check_output compares against a numpy
+reference, check_grad compares analytic (tape) gradients against central
+finite differences. The workhorse pattern for the op surface.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor, to_tensor
+
+
+def check_output(op_fn: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                 kwargs: Dict = None, atol=1e-5, rtol=1e-5):
+    """Run op_fn on Tensors and np_ref on numpy; compare."""
+    kwargs = kwargs or {}
+    tensors = [to_tensor(x) for x in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_ref(*inputs, **kwargs)
+    _assert_tree_close(out, ref, atol, rtol)
+
+
+def _assert_tree_close(out, ref, atol, rtol):
+    if isinstance(out, (list, tuple)):
+        assert isinstance(ref, (list, tuple)), f"{type(out)} vs {type(ref)}"
+        for o, r in zip(out, ref):
+            _assert_tree_close(o, r, atol, rtol)
+        return
+    o = out.numpy() if isinstance(out, Tensor) else np.asarray(out)
+    np.testing.assert_allclose(o, np.asarray(ref), atol=atol, rtol=rtol)
+
+
+def check_grad(op_fn: Callable, inputs: Sequence[np.ndarray],
+               kwargs: Dict = None, atol=5e-3, rtol=5e-3, delta=1e-3,
+               inputs_to_check=None, reduce_fn=None):
+    """Analytic (tape) grads vs central finite differences.
+
+    op_fn's output is reduced to a scalar via sum (or reduce_fn).
+    """
+    kwargs = kwargs or {}
+    inputs = [np.asarray(x, np.float64).astype(np.float32) for x in inputs]
+    idxs = inputs_to_check if inputs_to_check is not None \
+        else list(range(len(inputs)))
+
+    def scalar(*nps):
+        tensors = [to_tensor(x, stop_gradient=(i not in idxs))
+                   for i, x in enumerate(nps)]
+        out = op_fn(*tensors, **kwargs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        if reduce_fn is not None:
+            return reduce_fn(out)
+        return paddle.sum(out * out)  # sum-of-squares: nontrivial cotangent
+
+    # analytic
+    tensors = [to_tensor(x, stop_gradient=(i not in idxs))
+               for i, x in enumerate(inputs)]
+    out = op_fn(*tensors, **kwargs)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    loss = reduce_fn(out) if reduce_fn is not None else paddle.sum(out * out)
+    loss.backward()
+    analytic = {i: tensors[i].grad.numpy() for i in idxs}
+
+    # numeric
+    for i in idxs:
+        x = inputs[i]
+        num = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        num_flat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + delta
+            lp = float(scalar(*inputs).item())
+            flat[j] = orig - delta
+            lm = float(scalar(*inputs).item())
+            flat[j] = orig
+            num_flat[j] = (lp - lm) / (2 * delta)
+        np.testing.assert_allclose(
+            analytic[i], num.astype(np.float32), atol=atol, rtol=rtol,
+            err_msg=f"gradient mismatch for input {i}")

@@ -1,0 +1,239 @@
+"""Autograd engine tests (backward, grad, hooks, PyLayer, gradcheck)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, grad, vjp, jvp, jacobian, hessian
+from op_test import check_grad
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = paddle.sum(x * x * x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3 * np.array([4.0, 9.0]),
+                                   rtol=1e-5)
+
+    def test_matmul_grad(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        check_grad(paddle.matmul, [a, b])
+
+    def test_broadcast_grad(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4).astype("float32")
+        check_grad(paddle.add, [a, b])
+        check_grad(paddle.multiply, [a, b])
+
+    def test_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0], stop_gradient=True)
+        z = paddle.sum(x * y)
+        z.backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * 2
+        d = y.detach()
+        assert d.stop_gradient
+        z = paddle.sum(y * 2)
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.sum(x * x)
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_double_backward_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.sum(x * x)
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        remove = x.register_hook(hook)
+        paddle.sum(x * 3).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+        remove()
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.random.randn(4, 6).astype("float32"),
+                             stop_gradient=False)
+        a, b = paddle.split(x, 2, axis=1)
+        loss = paddle.sum(a * 2) + paddle.sum(b * 3)
+        loss.backward()
+        ref = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 3.0)],
+                             axis=1)
+        np.testing.assert_allclose(x.grad.numpy(), ref)
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (gx,) = grad(y, x, create_graph=False)
+        np.testing.assert_allclose(gx.numpy(), [4.0])
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_create_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.sum(x * x * x)
+        (gx,) = grad(y, x, create_graph=True)
+        gy = paddle.sum(gx)
+        gy.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-5)
+
+    def test_vjp_jvp(self):
+        def f(x):
+            return paddle.sum(x * x)
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        out, g = vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+        out, tangent = jvp(f, x)
+        np.testing.assert_allclose(tangent.item(), 6.0)
+
+    def test_jacobian_hessian(self):
+        def f(x):
+            return x * x
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        j = jacobian(f, x)
+        np.testing.assert_allclose(j.numpy(), np.diag([2.0, 4.0]))
+
+        def g(x):
+            return paddle.sum(x * x * x)
+        h = hessian(g, x)
+        np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]))
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor()
+                return gy * 3 * x * x
+
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = Cube.apply(x)
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_pylayer_no_instantiate(self):
+        class L(PyLayer):
+            pass
+        with pytest.raises(RuntimeError):
+            L()
+
+
+class TestNoGrad:
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_no_grad_decorator(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+
+        @paddle.no_grad()
+        def f(v):
+            return v * 2
+        assert f(x).stop_gradient
+
+
+class TestFunctionalGradChecks:
+    def test_softmax_grad(self):
+        a = np.random.randn(3, 5).astype("float32")
+        from paddle_tpu.nn import functional as F
+        check_grad(F.softmax, [a])
+
+    def test_layer_norm_grad(self):
+        a = np.random.randn(2, 6).astype("float32")
+        w = np.random.rand(6).astype("float32") + 0.5
+        b = np.random.randn(6).astype("float32")
+        from paddle_tpu.nn import functional as F
+        check_grad(lambda x, w_, b_: F.layer_norm(x, 6, w_, b_), [a, w, b],
+                   atol=1e-2, rtol=1e-2)
+
+    def test_conv2d_grad(self):
+        x = np.random.randn(2, 2, 6, 6).astype("float32")
+        w = np.random.randn(3, 2, 3, 3).astype("float32")
+        from paddle_tpu.nn import functional as F
+        check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w],
+                   atol=5e-2, rtol=5e-2, delta=1e-2)
+
+    def test_attention_grad(self):
+        q = np.random.randn(2, 4, 2, 8).astype("float32")
+        k = np.random.randn(2, 4, 2, 8).astype("float32")
+        v = np.random.randn(2, 4, 2, 8).astype("float32")
+        from paddle_tpu.nn import functional as F
+        check_grad(lambda a, b, c: F.scaled_dot_product_attention(
+            a, b, c, is_causal=True), [q, k, v], atol=5e-2, rtol=5e-2,
+            delta=1e-2)
+
+
+class TestInplaceTape:
+    """Regressions for the in-place op tape rebinding (code review r1)."""
+
+    def test_reshape_inplace_backward(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+        y = x * 2
+        y.reshape_([4])
+        paddle.sum(y * 1.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+
+    def test_increment_backward(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 3
+        paddle.increment(y, 1.0)
+        paddle.sum(y * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_relu_inplace_backward(self):
+        from paddle_tpu.nn import functional as F
+        x = paddle.to_tensor([-1.0, 2.0], stop_gradient=False)
+        y = x * 1.0
+        F.relu_(y)
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0])
+
+    def test_tensor_math_methods_installed(self):
+        t = paddle.to_tensor([[1.0, 2.0]])
+        assert t.sum().item() == 3.0
+        assert t.mean().item() == 1.5
+        assert t.abs().shape == [1, 2]
+        assert t.exp().shape == [1, 2]
+
+    def test_split_nondivisible_raises(self):
+        with pytest.raises(Exception):
+            paddle.split(paddle.arange(7), 3)
+
+    def test_unfold_layout(self):
+        u = paddle.tensor.unfold(paddle.randn([10, 4]), 0, 3, 1)
+        assert u.shape == [8, 4, 3]

@@ -1,0 +1,168 @@
+"""End-to-end training: LeNet on synthetic MNIST, eager + jit paths.
+
+Mirrors BASELINE.json config #1 (MNIST LeNet) and the reference's
+book-test style golden runs (SURVEY.md §4).
+"""
+import numpy as np
+import jax
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_lenet_eager_convergence():
+    paddle.seed(42)
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    x = paddle.randn([16, 1, 28, 28])
+    y = paddle.randint(0, 10, [16])
+    losses = []
+    for _ in range(10):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_lenet_jit_step_matches_eager():
+    paddle.seed(7)
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    params = net.parameters()
+    raw0 = [p._array for p in params]
+    x = np.random.randn(8, 1, 28, 28).astype("float32")
+    y = np.random.randint(0, 10, (8,)).astype("int32")
+
+    def train_step(raw_params, xa, ya):
+        for p, arr in zip(params, raw_params):
+            p._set_array(arr)
+            p.grad = None
+            p._node = None
+        loss = loss_fn(net(paddle.Tensor(xa, stop_gradient=True)),
+                       paddle.Tensor(ya))
+        loss.backward()
+        opt.step()
+        return [p._array for p in params], loss._array
+
+    eager_params, eager_loss = train_step(raw0, x, y)
+    eager_params = [np.asarray(a) for a in eager_params]
+
+    jit_step = jax.jit(train_step)
+    jit_params, jit_loss = jit_step(raw0, x, y)
+    np.testing.assert_allclose(float(eager_loss), float(jit_loss),
+                               rtol=1e-5)
+    for a, b in zip(eager_params, jit_params):
+        np.testing.assert_allclose(a, np.asarray(b), atol=1e-5)
+
+
+def test_dataloader_training_loop():
+    paddle.seed(0)
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.io import DataLoader
+    ds = MNIST(mode="train", backend="synthetic")
+    loader = DataLoader(ds, batch_size=32, shuffle=True, drop_last=True)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(784, 64)
+            self.fc2 = nn.Linear(64, 10)
+
+        def forward(self, x):
+            x = paddle.reshape(x, [x.shape[0], -1])
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = MLP()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    losses = []
+    for i, (img, label) in enumerate(loader):
+        loss = loss_fn(net(img), paddle.reshape(label, [-1]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(loss.item())
+        if i >= 20:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_hapi_model_fit():
+    paddle.seed(0)
+    from paddle_tpu.vision.datasets import MNIST
+    ds = MNIST(mode="train", backend="synthetic")
+    net = nn.Sequential(nn.Flatten(0 if False else 1),
+                        nn.Linear(784, 32), nn.ReLU(), nn.Linear(32, 10))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    hist = model.fit(ds, batch_size=64, epochs=1, verbose=0, num_iters=20)
+    out = model.evaluate(ds, batch_size=64, verbose=0)
+    assert "acc" in out and 0.0 <= out["acc"] <= 1.0
+
+
+def test_save_load_checkpoint(tmp_path):
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    x = paddle.randn([2, 1, 28, 28])
+    loss = paddle.sum(net(x))
+    loss.backward()
+    opt.step()
+    path = str(tmp_path / "ckpt")
+    paddle.save(net.state_dict(), path + ".pdparams")
+    paddle.save(opt.state_dict(), path + ".pdopt")
+
+    net2 = paddle.vision.models.LeNet()
+    net2.set_state_dict(paddle.load(path + ".pdparams"))
+    for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                  net2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy())
+    out1 = net(x)
+    out2 = net2(x)
+    np.testing.assert_allclose(out1.numpy(), out2.numpy(), atol=1e-6)
+
+
+def test_resnet18_forward():
+    net = paddle.vision.models.resnet18(num_classes=10)
+    net.eval()
+    out = net(paddle.randn([2, 3, 32, 32]))
+    assert out.shape == [2, 10]
+
+
+def test_amp_autocast():
+    net = nn.Linear(8, 8)
+    x = paddle.randn([2, 8])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = net(x)
+        assert str(out.dtype) == "bfloat16"
+        out32 = F.softmax(out)  # black list op -> fp32
+        assert str(out32.dtype) == "float32"
+    # outside the context nothing is cast
+    assert str(net(x).dtype) == "float32"
+
+
+def test_amp_grad_scaler():
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.randn([2, 4])
+    loss = paddle.mean(net(x) ** 2)
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    before = net.weight.numpy().copy()
+    scaler.step(opt)
+    assert not np.allclose(net.weight.numpy(), before)

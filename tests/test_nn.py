@@ -1,0 +1,315 @@
+"""nn.Layer system + layer library tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerSystem:
+    def test_parameters_and_naming(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = dict(net.named_parameters())
+        assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight",
+                              "fc2.bias"}
+        assert len(net.parameters()) == 4
+        assert all(not p.stop_gradient for p in net.parameters())
+
+    def test_state_dict_roundtrip(self):
+        net1 = nn.Linear(4, 3)
+        net2 = nn.Linear(4, 3)
+        net2.set_state_dict(net1.state_dict())
+        np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy())
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_train_eval(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        net(paddle.randn([1, 2]))
+        assert calls
+        h.remove()
+
+    def test_apply_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert str(net.weight.dtype) == "bfloat16"
+
+    def test_containers(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(list(ll.parameters())) == 8
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+        seq = nn.Sequential(("one", nn.Linear(2, 3)), ("two", nn.Linear(3, 1)))
+        assert seq(paddle.randn([4, 2])).shape == [4, 1]
+
+
+class TestLayers:
+    def test_linear(self):
+        layer = nn.Linear(4, 3)
+        x = paddle.randn([2, 4])
+        out = layer(x)
+        assert out.shape == [2, 3]
+        ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+    def test_conv2d(self):
+        layer = nn.Conv2D(3, 8, 3, padding=1, stride=2)
+        out = layer(paddle.randn([2, 3, 8, 8]))
+        assert out.shape == [2, 8, 4, 4]
+
+    def test_conv2d_groups_dilation(self):
+        layer = nn.Conv2D(4, 8, 3, padding=2, dilation=2, groups=2)
+        out = layer(paddle.randn([1, 4, 8, 8]))
+        assert out.shape == [1, 8, 8, 8]
+
+    def test_conv_transpose(self):
+        layer = nn.Conv2DTranspose(4, 2, 2, stride=2)
+        out = layer(paddle.randn([1, 4, 5, 5]))
+        assert out.shape == [1, 2, 10, 10]
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm1D(3)
+        x = paddle.randn([16, 3]) * 2 + 1
+        bn.train()
+        out = bn(x)
+        np.testing.assert_allclose(out.numpy().mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(out.numpy().std(0), 1, atol=1e-2)
+        assert abs(bn._mean.numpy()).sum() > 0  # stats updated
+        bn.eval()
+        out2 = bn(x)  # uses running stats; should differ from batch-norm'd
+        assert not np.allclose(out.numpy(), out2.numpy())
+
+    def test_layernorm_rmsnorm(self):
+        ln = nn.LayerNorm(6)
+        x = paddle.randn([2, 6])
+        out = ln(x)
+        np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-5)
+        rms = nn.RMSNorm(6)
+        out = rms(x)
+        ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1,
+                                                        keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.randn([2, 4, 5, 5])).shape == [2, 4, 5, 5]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([[0, 1], [2, 3]]))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+    def test_dropout(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = d(x)
+        kept = (out.numpy() != 0).mean()
+        assert 0.3 < kept < 0.7
+        np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+    def test_pools(self):
+        x = paddle.randn([1, 2, 8, 8])
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [1, 2, 1, 1]
+        a = x.numpy()
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D((1, 1))(x).numpy()[..., 0, 0],
+            a.mean((2, 3)), rtol=1e-5)
+
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_cache(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 1, 16])
+        cache = mha.gen_cache(x)
+        out, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 1
+        out, cache = mha(x, x, x, cache=cache)
+        assert cache.k.shape[1] == 2
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.randn([2, 5, 16]))
+        assert out.shape == [2, 5, 16]
+        # distinct layer copies
+        p = list(enc.named_parameters())
+        assert len({name.split(".")[1] for name, _ in p
+                    if name.startswith("layers.")}) == 2
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32,
+                               dropout=0.0)
+        src = paddle.randn([2, 6, 16])
+        tgt = paddle.randn([2, 4, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+    def test_lstm_gru(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.randn([2, 5, 8])
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 16] and c.shape == [2, 2, 16]
+        gru = nn.GRU(8, 16, direction="bidirect")
+        out, h = gru(x)
+        assert out.shape == [2, 5, 32]
+
+    def test_rnn_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.randn([3, 4])
+        out, (h, c) = cell(x)
+        assert out.shape == [3, 8]
+
+
+class TestLosses:
+    def test_cross_entropy_matches_manual(self):
+        logits = np.random.randn(4, 5).astype("float32")
+        labels = np.array([0, 1, 2, 3])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+        ref = -logp[np.arange(4), labels].mean()
+        np.testing.assert_allclose(loss.item(), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype("float32")
+        labels = np.array([0, -100, 2, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+        ref = -(logp[0, 0] + logp[2, 2]) / 2
+        np.testing.assert_allclose(loss.item(), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(4, 5).astype("float32")
+        soft = np.random.rand(4, 5).astype("float32")
+        soft /= soft.sum(1, keepdims=True)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(soft), soft_label=True)
+        logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+        np.testing.assert_allclose(loss.item(), -(soft * logp).sum(1).mean(),
+                                   rtol=1e-5)
+
+    def test_mse_l1_smooth(self):
+        a = np.random.randn(4, 3).astype("float32")
+        b = np.random.randn(4, 3).astype("float32")
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_bce(self):
+        p = np.random.rand(4).astype("float32") * 0.8 + 0.1
+        y = np.array([0, 1, 1, 0], dtype="float32")
+        np.testing.assert_allclose(
+            F.binary_cross_entropy(paddle.to_tensor(p),
+                                   paddle.to_tensor(y)).item(),
+            -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean(), rtol=1e-4)
+        z = np.random.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            F.binary_cross_entropy_with_logits(
+                paddle.to_tensor(z), paddle.to_tensor(y)).item(),
+            F.binary_cross_entropy(paddle.to_tensor(1 / (1 + np.exp(-z))),
+                                   paddle.to_tensor(y)).item(), rtol=1e-4)
+
+    def test_kl_nll(self):
+        logp = np.log(np.random.dirichlet(np.ones(5), 4).astype("float32"))
+        y = np.random.dirichlet(np.ones(5), 4).astype("float32")
+        np.testing.assert_allclose(
+            F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(y),
+                     reduction="sum").item(),
+            (y * (np.log(y) - logp)).sum(), rtol=1e-4)
+
+    def test_ctc_loss_smoke(self):
+        T, B, C, S = 6, 2, 4, 2
+        logits = np.random.randn(T, B, C).astype("float32")
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        labels = np.array([[1, 2], [2, 3]], dtype="int32")
+        loss = F.ctc_loss(paddle.to_tensor(logp), paddle.to_tensor(labels),
+                          paddle.to_tensor(np.array([T, T])),
+                          paddle.to_tensor(np.array([S, S])))
+        assert np.isfinite(loss.item()) and loss.item() > 0
+
+
+class TestActivations:
+    def test_values(self):
+        x = np.linspace(-3, 3, 13).astype("float32")
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(F.silu(t).numpy(),
+                                   x / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(paddle.to_tensor(x.reshape(1, -1))).numpy().sum(),
+            1.0, rtol=1e-5)
+        np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(),
+                                   np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        np.testing.assert_allclose(F.glu(paddle.to_tensor(
+            x[:12].reshape(2, 6))).numpy().shape, (2, 3))
+
+
+class TestInitializers:
+    def test_basic(self):
+        from paddle_tpu.nn import initializer as I
+        layer = nn.Linear(100, 50,
+                          weight_attr=paddle.ParamAttr(
+                              initializer=I.Constant(0.5)))
+        np.testing.assert_allclose(layer.weight.numpy(), 0.5)
+        layer = nn.Linear(1000, 500,
+                          weight_attr=paddle.ParamAttr(
+                              initializer=I.Normal(0.0, 0.02)))
+        assert abs(layer.weight.numpy().std() - 0.02) < 0.002
+        ortho = I.Orthogonal()( [32, 32], np.dtype("float32"))
+        np.testing.assert_allclose(np.asarray(ortho) @ np.asarray(ortho).T,
+                                   np.eye(32), atol=1e-4)
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        p1 = nn.Parameter(np.ones(4, dtype="float32"))
+        p1.grad = paddle.to_tensor(np.full(4, 3.0, dtype="float32"))
+        p2 = nn.Parameter(np.ones(4, dtype="float32"))
+        p2.grad = paddle.to_tensor(np.full(4, 4.0, dtype="float32"))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p1, p1.grad), (p2, p2.grad)])
+        total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)

@@ -1,0 +1,155 @@
+"""Optimizer + LR scheduler tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import (SGD, Momentum, Adam, AdamW, Adagrad,
+                                  Adamax, RMSProp, Adadelta, Lamb)
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def quad_problem():
+    """min ||Wx - y||^2 — parameters should converge."""
+    paddle.seed(0)
+    w = nn.Parameter(np.random.randn(4, 4).astype("float32"))
+    x = paddle.to_tensor(np.random.randn(16, 4).astype("float32"))
+    target = paddle.to_tensor(np.random.randn(16, 4).astype("float32"))
+    return w, x, target
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (SGD, dict(learning_rate=0.05)),
+    (Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (Adam, dict(learning_rate=0.05)),
+    (AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+    (Adagrad, dict(learning_rate=0.5)),
+    (Adamax, dict(learning_rate=0.05)),
+    (RMSProp, dict(learning_rate=0.01)),
+    (Adadelta, dict(learning_rate=1.0)),
+    (Lamb, dict(learning_rate=0.05)),
+])
+def test_optimizer_decreases_loss(opt_cls, kwargs):
+    w, x, target = quad_problem()
+    opt = opt_cls(parameters=[w], **kwargs)
+    first = None
+    for i in range(60):
+        loss = paddle.mean((paddle.matmul(x, w) - target) ** 2)
+        if first is None:
+            first = loss.item()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert loss.item() < first * 0.8, f"{opt_cls.__name__} failed to descend"
+
+
+def test_adam_matches_reference_formula():
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = Adam(learning_rate=0.1, parameters=[w], beta1=0.9, beta2=0.999,
+               epsilon=1e-8)
+    g = np.array([0.5], dtype="float32")
+    w.grad = paddle.to_tensor(g)
+    opt.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    m_hat = m / (1 - 0.9)
+    v_hat = v / (1 - 0.999)
+    ref = 1.0 - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), ref, rtol=1e-5)
+
+
+def test_weight_decay_coupled_vs_decoupled():
+    w1 = nn.Parameter(np.array([1.0], dtype="float32"))
+    w2 = nn.Parameter(np.array([1.0], dtype="float32"))
+    a1 = Adam(learning_rate=0.1, parameters=[w1], weight_decay=0.1)
+    a2 = AdamW(learning_rate=0.1, parameters=[w2], weight_decay=0.1)
+    for w, o in [(w1, a1), (w2, a2)]:
+        w.grad = paddle.to_tensor(np.array([0.5], dtype="float32"))
+        o.step()
+    assert not np.allclose(w1.numpy(), w2.numpy())
+
+
+def test_grad_clip_in_optimizer():
+    w, x, target = quad_problem()
+    opt = SGD(learning_rate=0.1, parameters=[w],
+              grad_clip=nn.ClipGradByGlobalNorm(0.001))
+    loss = paddle.mean((paddle.matmul(x, w) - target) ** 2)
+    loss.backward()
+    before = w.numpy().copy()
+    opt.step()
+    delta = np.abs(w.numpy() - before).sum()
+    assert delta < 0.001 * 0.1 * 16 + 1e-5
+
+
+def test_optimizer_state_dict_roundtrip():
+    w, x, target = quad_problem()
+    w.name = "w"
+    opt = Adam(learning_rate=0.1, parameters=[w])
+    loss = paddle.mean((paddle.matmul(x, w) - target) ** 2)
+    loss.backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert any("moment1" in k for k in sd)
+
+    w2 = nn.Parameter(w.numpy())
+    w2.name = "w"
+    opt2 = Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        opt2._accumulators["moment1"][id(w2)],
+        opt._accumulators["moment1"][id(w)])
+
+
+def test_lr_scheduler_integration():
+    w, x, target = quad_problem()
+    sched = lr_mod.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    opt = SGD(learning_rate=sched, parameters=[w])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+class TestSchedulers:
+    def test_values(self):
+        s = lr_mod.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+        vals = []
+        for _ in range(8):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.1 and vals[4] == 0.01 and vals[7] == 0.001
+
+        s = lr_mod.ExponentialDecay(1.0, 0.5)
+        s.step()
+        np.testing.assert_allclose(s(), 0.5)
+
+        s = lr_mod.CosineAnnealingDecay(1.0, 10)
+        v0 = s()
+        for _ in range(10):
+            s.step()
+        assert s() < v0 * 0.01 + 1e-6
+
+        s = lr_mod.LinearWarmup(0.1, 5, 0.0, 0.1)
+        vals = []
+        for _ in range(7):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals[:5],
+                                   [0.0, 0.02, 0.04, 0.06, 0.08],
+                                   atol=1e-6)
+        assert vals[6] == pytest.approx(0.1)
+
+        s = lr_mod.NoamDecay(d_model=512, warmup_steps=10,
+                             learning_rate=1.0)
+        peak_step_lr = None
+        for _ in range(20):
+            s.step()
+        assert s() > 0
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s() == pytest.approx(0.05)

@@ -1,0 +1,308 @@
+"""Tensor op surface tests (OpTest-style, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 3.5).numpy(), [3.5, 3.5])
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        assert paddle.eye(3).numpy().trace() == 3
+
+    def test_like(self):
+        x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        assert paddle.zeros_like(x).shape == [3, 4]
+        assert paddle.ones_like(x).numpy().sum() == 12
+        assert paddle.full_like(x, 2.0).numpy()[0, 0] == 2.0
+
+    def test_tril_triu(self):
+        a = np.random.randn(4, 4).astype("float32")
+        check_output(paddle.tril, np.tril, [a])
+        check_output(paddle.triu, np.triu, [a])
+
+    def test_to_tensor_scalars(self):
+        assert paddle.to_tensor(3).dtype in (np.dtype("int64"),
+                                             np.dtype("int32"))
+        assert paddle.to_tensor(3.0).dtype == np.dtype("float32")
+        assert paddle.to_tensor(True).dtype == np.dtype("bool")
+
+    def test_meshgrid(self):
+        x = paddle.arange(3).astype("float32")
+        y = paddle.arange(4).astype("float32")
+        gx, gy = paddle.meshgrid(x, y)
+        assert gx.shape == [3, 4] and gy.shape == [3, 4]
+
+
+class TestMath:
+    def test_elementwise_binary(self):
+        a = np.random.rand(3, 4).astype("float32") + 0.5
+        b = np.random.rand(3, 4).astype("float32") + 0.5
+        for op, ref in [(paddle.add, np.add), (paddle.subtract, np.subtract),
+                        (paddle.multiply, np.multiply),
+                        (paddle.divide, np.divide),
+                        (paddle.maximum, np.maximum),
+                        (paddle.minimum, np.minimum),
+                        (paddle.pow, np.power)]:
+            check_output(op, ref, [a, b], atol=1e-4)
+
+    def test_unary(self):
+        a = np.random.rand(3, 4).astype("float32") + 0.1
+        for op, ref in [(paddle.exp, np.exp), (paddle.log, np.log),
+                        (paddle.sqrt, np.sqrt), (paddle.abs, np.abs),
+                        (paddle.tanh, np.tanh), (paddle.sin, np.sin),
+                        (paddle.floor, np.floor), (paddle.ceil, np.ceil)]:
+            check_output(op, ref, [a], atol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.sum(paddle.to_tensor(a)).item(),
+                                   a.sum(), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.mean(paddle.to_tensor(a), axis=1).numpy(),
+            a.mean(axis=1), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.max(paddle.to_tensor(a), axis=[0, 2]).numpy(),
+            a.max(axis=(0, 2)), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.std(paddle.to_tensor(a)).item(), a.std(ddof=1),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(a), axis=-1).numpy(),
+            np.log(np.exp(a).sum(-1)), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.randn(3, 4).astype("float32")
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda x: np.cumsum(x, axis=1), [a])
+        check_output(lambda x: paddle.clip(x, -0.5, 0.5),
+                     lambda x: np.clip(x, -0.5, 0.5), [a])
+
+    def test_operator_overloads(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a * 2).numpy(), [2, 4])
+        np.testing.assert_allclose((2 - a).numpy(), [1, 0])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+        assert bool((a < b).numpy().all())
+
+    def test_scale_lerp(self):
+        a = np.random.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.scale(paddle.to_tensor(a), 2.0, 1.0).numpy(),
+            a * 2 + 1, rtol=1e-6)
+        b = np.random.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.lerp(paddle.to_tensor(a), paddle.to_tensor(b),
+                        0.3).numpy(),
+            a + 0.3 * (b - a), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24).reshape(2, 3, 4).astype("float32")
+        t = paddle.to_tensor(a)
+        assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+        assert paddle.reshape(t, [0, -1]).shape == [2, 12]
+        np.testing.assert_allclose(
+            paddle.transpose(t, [2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+        assert paddle.flatten(t, 1).shape == [2, 12]
+        assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype("float32")
+        b = np.random.randn(2, 3).astype("float32")
+        c = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(c.numpy(), np.concatenate([a, b]))
+        s = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        assert s.shape == [2, 2, 3]
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype("float32")
+        idx = np.array([0, 2, 4])
+        np.testing.assert_allclose(
+            paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx)).numpy(),
+            a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.scatter(paddle.to_tensor(a), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        ref = a.copy()
+        ref[idx] = 1.0
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_gather_nd(self):
+        a = np.random.randn(3, 4, 5).astype("float32")
+        idx = np.array([[0, 1], [2, 3]])
+        np.testing.assert_allclose(
+            paddle.gather_nd(paddle.to_tensor(a),
+                             paddle.to_tensor(idx)).numpy(),
+            a[[0, 2], [1, 3]])
+
+    def test_where_masked_fill(self):
+        a = np.random.randn(3, 4).astype("float32")
+        cond = a > 0
+        np.testing.assert_allclose(
+            paddle.where(paddle.to_tensor(cond), paddle.to_tensor(a),
+                         paddle.to_tensor(-a)).numpy(),
+            np.where(cond, a, -a))
+        np.testing.assert_allclose(
+            paddle.masked_fill(paddle.to_tensor(a), paddle.to_tensor(cond),
+                               0.0).numpy(),
+            np.where(cond, 0, a))
+
+    def test_tile_expand_flip_roll(self):
+        a = np.random.randn(2, 3).astype("float32")
+        np.testing.assert_allclose(
+            paddle.tile(paddle.to_tensor(a), [2, 2]).numpy(),
+            np.tile(a, [2, 2]))
+        np.testing.assert_allclose(
+            paddle.expand(paddle.to_tensor(a[None]), [4, 2, 3]).numpy(),
+            np.broadcast_to(a[None], (4, 2, 3)))
+        np.testing.assert_allclose(
+            paddle.flip(paddle.to_tensor(a), [0]).numpy(), a[::-1])
+        np.testing.assert_allclose(
+            paddle.roll(paddle.to_tensor(a), 1, 0).numpy(),
+            np.roll(a, 1, 0))
+
+    def test_pad(self):
+        a = np.random.randn(2, 3).astype("float32")
+        out = paddle.tensor.pad(paddle.to_tensor(a), [1, 1, 2, 2],
+                                value=0.0)
+        assert out.shape == [4, 7] or out.shape == [6, 5]
+
+    def test_getitem_setitem(self):
+        a = np.arange(12).reshape(3, 4).astype("float32")
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(t[1].numpy(), a[1])
+        np.testing.assert_allclose(t[:, 1:3].numpy(), a[:, 1:3])
+        np.testing.assert_allclose(t[paddle.to_tensor([0, 2])].numpy(),
+                                   a[[0, 2]])
+        t[0] = 0.0
+        assert t.numpy()[0].sum() == 0
+
+    def test_cast(self):
+        a = paddle.to_tensor([1.7, 2.3])
+        assert paddle.cast(a, "int32").dtype == np.dtype("int32")
+        assert a.astype("float16").dtype == np.dtype("float16")
+
+    def test_take_along_put_along(self):
+        a = np.random.randn(3, 4).astype("float32")
+        idx = np.argsort(a, axis=1)
+        np.testing.assert_allclose(
+            paddle.take_along_axis(paddle.to_tensor(a),
+                                   paddle.to_tensor(idx), 1).numpy(),
+            np.take_along_axis(a, idx, 1))
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        check_output(paddle.matmul, np.matmul, [a, b], atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b.T),
+                          transpose_y=True).numpy(),
+            a @ b, atol=1e-4)
+
+    def test_solve_inv_det(self):
+        a = np.random.randn(4, 4).astype("float32")
+        a = a @ a.T + 4 * np.eye(4, dtype="float32")
+        b = np.random.randn(4, 2).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(a),
+                                paddle.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), atol=1e-3)
+        np.testing.assert_allclose(
+            paddle.linalg.det(paddle.to_tensor(a)).item(),
+            np.linalg.det(a), rtol=1e-3)
+
+    def test_cholesky_qr_svd(self):
+        a = np.random.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        l = paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(l @ l.T, spd, atol=1e-3)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-3)
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vh.numpy(), a, atol=1e-3)
+
+    def test_norm_einsum(self):
+        a = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.norm(paddle.to_tensor(a)).item(),
+            np.linalg.norm(a), rtol=1e-5)
+        b = np.random.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                          paddle.to_tensor(b)).numpy(),
+            a @ b, atol=1e-4)
+
+
+class TestSearch:
+    def test_argmax_sort_topk(self):
+        a = np.random.randn(3, 5).astype("float32")
+        np.testing.assert_allclose(
+            paddle.argmax(paddle.to_tensor(a), axis=1).numpy(),
+            a.argmax(1))
+        np.testing.assert_allclose(
+            paddle.sort(paddle.to_tensor(a), axis=1).numpy(), np.sort(a, 1))
+        vals, idx = paddle.topk(paddle.to_tensor(a), 2, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_nonzero_unique(self):
+        a = np.array([[1, 0], [0, 2]], dtype="float32")
+        nz = paddle.nonzero(paddle.to_tensor(a))
+        np.testing.assert_allclose(nz.numpy(), [[0, 0], [1, 1]])
+        u = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+        np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+
+    def test_searchsorted(self):
+        s = np.array([1.0, 3.0, 5.0, 7.0], dtype="float32")
+        v = np.array([2.0, 6.0], dtype="float32")
+        np.testing.assert_allclose(
+            paddle.searchsorted(paddle.to_tensor(s),
+                                paddle.to_tensor(v)).numpy(),
+            np.searchsorted(s, v))
+
+
+class TestLogic:
+    def test_compare(self):
+        a = np.array([1.0, 2.0, 3.0], dtype="float32")
+        b = np.array([2.0, 2.0, 2.0], dtype="float32")
+        assert (paddle.equal(paddle.to_tensor(a), paddle.to_tensor(b))
+                .numpy() == (a == b)).all()
+        assert paddle.allclose(paddle.to_tensor(a),
+                               paddle.to_tensor(a)).item()
+        assert not paddle.equal_all(paddle.to_tensor(a),
+                                    paddle.to_tensor(b)).item()
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 4])
+        paddle.seed(7)
+        b = paddle.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert paddle.rand([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
