@@ -178,16 +178,18 @@ class GradScaler:
         self._found_inf = found
 
     def step(self, optimizer):
+        # like the reference AmpScaler.step: no scale update here — the
+        # canonical pattern is scaler.step(opt); scaler.update()
         if not self._enable:
             optimizer.step()
             return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
         if not (self._enable and self._dynamic):
@@ -198,6 +200,7 @@ class GradScaler:
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+            self._found_inf = False
         else:
             self._good_steps += 1
             self._bad_steps = 0

@@ -151,7 +151,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if op in (ReduceOp.AVG, "avg"):
             return lax.pmean(a, axis)
         if op in (ReduceOp.PROD, "prod"):
-            return jnp.exp(lax.psum(jnp.log(a), axis))
+            # sign/magnitude decomposition: log/exp alone breaks on
+            # zeros and negatives
+            mag = jnp.exp(lax.psum(jnp.log(jnp.maximum(jnp.abs(a), 1e-38)),
+                                   axis))
+            neg = lax.psum((a < 0).astype(jnp.int32), axis)
+            has_zero = lax.pmax((a == 0).astype(jnp.int32), axis)
+            sign = jnp.where(neg % 2 == 1, -1.0, 1.0).astype(a.dtype)
+            return jnp.where(has_zero == 1, jnp.zeros_like(mag),
+                             sign * mag.astype(a.dtype))
         raise ValueError(f"unknown op {op}")
     out = apply_op(_f, tensor, op_name="all_reduce")
     tensor._set_array(out._array)

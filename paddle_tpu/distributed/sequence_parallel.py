@@ -1,0 +1,147 @@
+"""Long-context sequence/context parallelism: ring attention + Ulysses.
+
+The reference snapshot has NO sequence parallelism (SURVEY.md §5: zero hits
+for ring_attention/context_parallel/ulysses) — this is a to-design feature
+the TPU build adds natively on top of mesh collectives:
+
+- **Ring attention** (context parallel): Q/K/V sharded on the sequence dim
+  over a mesh axis; K/V blocks rotate around the ring via lax.ppermute
+  (ICI neighbor DMA) while each device accumulates its Q-block's attention
+  with an online-softmax merge — memory O(S/n), exact causal attention.
+- **Ulysses**: all_to_all reshards [B, S/n, H, D] -> [B, S, H/n, D], runs
+  full attention locally on a head slice, and reshards back — one
+  all_to_all each way over the axis, best when H % n == 0.
+
+Both are exposed two ways: axis-level functions usable inside an existing
+shard_map (the building-block form), and mesh-level wrappers that apply
+shard_map themselves.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "ring_attention_sharded",
+           "ulysses_attention_sharded"]
+
+
+def _block_attn(q, k, v, scale, q_off, k_off, causal):
+    """Blockwise attention stats for online-softmax merging.
+
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D]; returns (m, l, acc) with
+    m,l: [B, H, Sq] f32 and acc: [B, H, Sq, D] f32 (un-normalized).
+    q_off/k_off: global offsets of the blocks for causal masking.
+    """
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B,H,Sq,D]
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                          # [B,H,Sq]
+    # fully-masked rows: keep m finite so exp() is well-defined
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return m_safe, l, acc
+
+
+def _merge(m1, l1, a1, m2, l2, a2):
+    """Merge two online-softmax partial results."""
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.where(l1 > 0, jnp.exp(m1 - m), 0.0)
+    c2 = jnp.where(l2 > 0, jnp.exp(m2 - m), 0.0)
+    return m, l1 * c1 + l2 * c2, a1 * c1[..., None] + a2 * c2[..., None]
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Exact (causal) attention with sequence sharded over `axis_name`.
+
+    Call INSIDE shard_map: q/k/v are the local [B, S_local, H, D] blocks.
+    K/V rotate around the ring; n-1 ppermute steps overlap with compute.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_off = idx * Sl
+
+    def step(i, carry):
+        m, l, acc, kc, vc = carry
+        # kv block currently held arrived from device (idx - i) mod n
+        src = (idx - i) % n
+        k_off = src * Sl
+        bm, bl, bacc = _block_attn(q, kc, vc, scale, q_off, k_off, causal)
+        m, l, acc = _merge(m, l, acc, bm, bl, bacc)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return m, l, acc, kc, vc
+
+    m0 = jnp.full((B, H, Sl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), jnp.float32)
+    a0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+    m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, a0, k, v))
+    out = acc / jnp.maximum(l, 1e-38)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)   # [B,Sl,H,D]
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      attn_fn=None):
+    """Ulysses SP: head<->sequence all_to_all around a local full-sequence
+    attention. Call INSIDE shard_map with seq-sharded [B, S/n, H, D]."""
+    n = lax.axis_size(axis_name)
+    H = q.shape[2]
+    assert H % n == 0, f"heads {H} not divisible by sp degree {n}"
+
+    def to_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):    # [B, S, H/n, D] -> [B, S/n, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if attn_fn is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        m, l, acc = _block_attn(qh, kh, vh, scale, 0, 0, causal)
+        out = acc / jnp.maximum(l, 1e-38)[..., None]
+        out = jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    else:
+        out = attn_fn(qh, kh, vh)
+    return to_seq(out)
+
+
+def _sharded(fn, mesh, axis_name):
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
+                           causal: bool = True):
+    """Mesh-level wrapper: q/k/v are global [B, S, H, D]; S is (re)sharded
+    over `axis_name` and ring attention runs under shard_map."""
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal)
+    return _sharded(lambda a, b, c: fn(a, b, c), mesh, axis_name)(q, k, v)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, axis_name: str = "sp",
+                              causal: bool = True):
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal)
+    return _sharded(lambda a, b, c: fn(a, b, c), mesh, axis_name)(q, k, v)

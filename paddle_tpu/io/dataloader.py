@@ -67,10 +67,12 @@ def _to_tensor_tree(data):
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 num_workers, base_seed):
+                 num_workers, base_seed, init_fn=None):
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset,
                                    base_seed + worker_id)
     np.random.seed(base_seed + worker_id)
+    if init_fn is not None:
+        init_fn(worker_id)
     while True:
         item = index_queue.get()
         if item is None:
@@ -149,7 +151,7 @@ class DataLoader:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, iq, data_queue, self.collate_fn, wid,
-                      self.num_workers, base_seed),
+                      self.num_workers, base_seed, self.worker_init_fn),
                 daemon=True)
             w.start()
             workers.append(w)

@@ -56,21 +56,20 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             out = out * w.reshape(shape)
         if b is not None:
             out = out + b.reshape(shape)
-        return out
-    out = apply_op(_f, *args, op_name="batch_norm")
+        return out, mean, var
+    out, batch_mean, batch_var = apply_op(_f, *args, op_name="batch_norm")
 
     # update running stats in place (matches reference's in-place update);
     # works under trace too — the new stats become traced values the caller's
-    # functional step can return.
+    # functional step can return. Stats are the ones computed inside _f,
+    # not a second reduction over x.
     if use_batch_stats and isinstance(running_mean, Tensor):
-        batch_mean = jnp.mean(x._array, axis=reduce_axes)
-        batch_var = jnp.var(x._array, axis=reduce_axes)
         n = 1
         for ax in reduce_axes:
             n *= x._array.shape[ax]
-        unbiased = batch_var * (n / max(n - 1, 1))
+        unbiased = batch_var._array * (n / max(n - 1, 1))
         running_mean._set_array(momentum * running_mean._array
-                                + (1 - momentum) * batch_mean)
+                                + (1 - momentum) * batch_mean._array)
         running_var._set_array(momentum * running_var._array
                                + (1 - momentum) * unbiased)
     return out
@@ -198,14 +197,15 @@ def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
     x = _ensure_tensor(x)
+    ch_axis = x.ndim - 1 if data_format.endswith("C") else 1
 
     def _f(a):
         sq = a * a
-        ch_axis = 1
         c = a.shape[ch_axis]
         half = size // 2
-        padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)]
-                         + [(0, 0)] * (a.ndim - 2))
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pads)
         windows = sum(lax.slice_in_dim(padded, i, i + c, axis=ch_axis)
                       for i in range(size))
         div = (k + alpha / size * windows) ** beta

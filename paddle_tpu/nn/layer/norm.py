@@ -7,6 +7,7 @@ first-class (LLM configs).
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from .layers import Layer
 from .. import functional as F
@@ -229,12 +230,18 @@ class SpectralNorm(Layer):
 
         def _f(w):
             wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            # power iteration refines the persistent u/v estimate; gradients
+            # do not flow through it (reference treats U/V as buffers)
             u, v = u0, v0
             for _ in range(power_iters):
-                v = wm.T @ u
+                v = lax.stop_gradient(wm).T @ u
                 v = v / (jnp.linalg.norm(v) + eps)
-                u = wm @ v
+                u = lax.stop_gradient(wm) @ v
                 u = u / (jnp.linalg.norm(u) + eps)
             sigma = u @ wm @ v
-            return w / sigma
-        return apply_op(_f, weight, op_name="spectral_norm")
+            return w / sigma, u, v
+        out, u_new, v_new = apply_op(_f, weight, op_name="spectral_norm")
+        # persist the refined vectors so sigma converges across forwards
+        self.weight_u._set_array(u_new._array)
+        self.weight_v._set_array(v_new._array)
+        return out

@@ -133,10 +133,13 @@ class Optimizer:
         self._step_count = int(state_dict.get("@step", 0))
         if "LR_Scheduler" in state_dict and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        # longest-name-first so a param name that is a prefix of another
+        # ("linear" vs "linear_2") cannot steal the longer param's state
+        by_len = sorted(name_of.items(), key=lambda kv: -len(kv[0]))
         for key, val in state_dict.items():
             if key in ("LR_Scheduler", "@step"):
                 continue
-            for pname, p in name_of.items():
+            for pname, p in by_len:
                 if key.startswith(pname + "_"):
                     acc_name = key[len(pname) + 1:]
                     arr = val._array if isinstance(val, Tensor) \

@@ -171,9 +171,26 @@ def _getitem(self, item):
 
 
 def _setitem(self, item, value):
+    from ..core.tensor import (apply_op, is_grad_enabled, rebind_inplace,
+                               tape_snapshot)
     idx = _idx_conv(item)
-    v = value._array if isinstance(value, Tensor) else value
-    self._set_array(self._array.at[idx].set(v))
+    v_is_t = isinstance(value, Tensor)
+    needs_grad = is_grad_enabled() and (
+        not self.stop_gradient or (v_is_t and not value.stop_gradient))
+    if not needs_grad:
+        v = value._array if v_is_t else value
+        self._set_array(self._array.at[idx].set(v))
+        return
+    # record as an in-place op so cotangents flow both to the pre-mutation
+    # value (zeros at the overwritten slots) and to `value` (gathered)
+    snap = tape_snapshot(self)
+    if v_is_t:
+        out = apply_op(lambda a, v: a.at[idx].set(v), snap, value,
+                       op_name="setitem")
+    else:
+        out = apply_op(lambda a: a.at[idx].set(value), snap,
+                       op_name="setitem")
+    rebind_inplace(self, out)
 
 
 _install_methods()
