@@ -1,0 +1,192 @@
+// Shared-memory blocking ring queue for DataLoader tensor transport.
+//
+// TPU-native counterpart of the reference's reader plumbing:
+// paddle/fluid/operators/reader/blocking_queue.h (bounded blocking queue)
+// combined with the shared-memory LoDTensor transport used by the
+// multiprocess DataLoader (python/paddle/fluid/dataloader/worker.py).
+// Worker processes memcpy serialized batches into a POSIX shm ring; the
+// trainer process pops them without the pipe copies of mp.Queue.
+//
+// Layout: [Header][slot 0][slot 1]...[slot n-1], each slot =
+// [uint64 len][payload bytes]. Synchronization: process-shared pthread
+// mutex + condvars living inside the shm header.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t n_slots;
+  uint64_t slot_bytes;  // payload capacity per slot (excl. len word)
+  uint64_t head;        // next slot to pop
+  uint64_t tail;        // next slot to push
+  uint64_t count;
+  uint32_t closed;
+  uint32_t _pad;
+};
+
+struct Queue {
+  Header* hdr;
+  uint8_t* slots;
+  size_t map_bytes;
+  char name[256];
+  bool owner;
+};
+
+inline uint8_t* slot_ptr(Queue* q, uint64_t i) {
+  return q->slots + i * (sizeof(uint64_t) + q->hdr->slot_bytes);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a queue. Returns nullptr on error.
+void* ptq_shm_queue_open(const char* name, uint64_t n_slots,
+                         uint64_t slot_bytes, int owner) {
+  size_t bytes =
+      sizeof(Header) + n_slots * (sizeof(uint64_t) + slot_bytes);
+  int flags = owner ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  if (owner && ftruncate(fd, (off_t)bytes) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+
+  Queue* q = new Queue();
+  q->hdr = reinterpret_cast<Header*>(mem);
+  q->slots = reinterpret_cast<uint8_t*>(mem) + sizeof(Header);
+  q->map_bytes = bytes;
+  snprintf(q->name, sizeof(q->name), "%s", name);
+  q->owner = owner != 0;
+
+  if (owner) {
+    Header* h = q->hdr;
+    memset(h, 0, sizeof(Header));
+    h->n_slots = n_slots;
+    h->slot_bytes = slot_bytes;
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutex_init(&h->mu, &ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_cond_init(&h->not_empty, &ca);
+    pthread_cond_init(&h->not_full, &ca);
+  }
+  return q;
+}
+
+// Push payload; blocks while full. Returns 0 ok, -1 closed, -2 too large.
+int ptq_shm_queue_push(void* qp, const uint8_t* data, uint64_t len) {
+  Queue* q = reinterpret_cast<Queue*>(qp);
+  Header* h = q->hdr;
+  if (len > h->slot_bytes) return -2;
+  pthread_mutex_lock(&h->mu);
+  while (h->count == h->n_slots && !h->closed)
+    pthread_cond_wait(&h->not_full, &h->mu);
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint8_t* s = slot_ptr(q, h->tail);
+  memcpy(s, &len, sizeof(uint64_t));
+  memcpy(s + sizeof(uint64_t), data, len);
+  h->tail = (h->tail + 1) % h->n_slots;
+  h->count++;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Pop into buf (cap bytes). Returns payload size, -1 if closed+empty,
+// -2 if buf too small (item is left in the queue).
+int64_t ptq_shm_queue_pop(void* qp, uint8_t* buf, uint64_t cap) {
+  Queue* q = reinterpret_cast<Queue*>(qp);
+  Header* h = q->hdr;
+  pthread_mutex_lock(&h->mu);
+  while (h->count == 0 && !h->closed)
+    pthread_cond_wait(&h->not_empty, &h->mu);
+  if (h->count == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint8_t* s = slot_ptr(q, h->head);
+  uint64_t len;
+  memcpy(&len, s, sizeof(uint64_t));
+  if (len > cap) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  memcpy(buf, s + sizeof(uint64_t), len);
+  h->head = (h->head + 1) % h->n_slots;
+  h->count--;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)len;
+}
+
+// Size of the item at the head (for buffer allocation); -1 empty+closed,
+// 0 with *waiting*=1 if empty but open.
+int64_t ptq_shm_queue_peek_size(void* qp) {
+  Queue* q = reinterpret_cast<Queue*>(qp);
+  Header* h = q->hdr;
+  pthread_mutex_lock(&h->mu);
+  while (h->count == 0 && !h->closed)
+    pthread_cond_wait(&h->not_empty, &h->mu);
+  if (h->count == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -1;
+  }
+  uint64_t len;
+  memcpy(&len, slot_ptr(q, h->head), sizeof(uint64_t));
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)len;
+}
+
+uint64_t ptq_shm_queue_count(void* qp) {
+  Queue* q = reinterpret_cast<Queue*>(qp);
+  pthread_mutex_lock(&q->hdr->mu);
+  uint64_t c = q->hdr->count;
+  pthread_mutex_unlock(&q->hdr->mu);
+  return c;
+}
+
+void ptq_shm_queue_close(void* qp) {
+  Queue* q = reinterpret_cast<Queue*>(qp);
+  Header* h = q->hdr;
+  pthread_mutex_lock(&h->mu);
+  h->closed = 1;
+  pthread_cond_broadcast(&h->not_empty);
+  pthread_cond_broadcast(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+}
+
+void ptq_shm_queue_free(void* qp) {
+  Queue* q = reinterpret_cast<Queue*>(qp);
+  bool owner = q->owner;
+  char name[256];
+  snprintf(name, sizeof(name), "%s", q->name);
+  munmap(q->hdr, q->map_bytes);
+  if (owner) shm_unlink(name);
+  delete q;
+}
+
+}  // extern "C"

@@ -1,0 +1,280 @@
+// TCPStore — key-value rendezvous for multi-host distributed init.
+//
+// TPU-native counterpart of the reference's
+// paddle/phi/core/distributed/store/tcp_store.{h,cc} (TCPStore:117) used by
+// ProcessGroup bootstrap: rank 0 runs a server; all ranks set/get/add/wait
+// keys (NCCL unique ids there, coordinator addresses here).
+//
+// Wire protocol (little-endian):
+//   request  = u8 op | u32 klen | key | u64 vlen/delta | value
+//   ops: 1=SET 2=GET 3=ADD 4=WAIT 5=DELETE
+//   response = i64 status/len | payload
+// Server: one thread per connection (connection count == world size scale).
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct StoreState {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::atomic<bool> stop{false};
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = reinterpret_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+void serve_conn(StoreState* st, int fd) {
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_full(fd, &klen, 4) || klen > 1 << 20) break;
+    std::string key(klen, '\0');
+    if (!read_full(fd, &key[0], klen)) break;
+    uint64_t vlen;
+    if (!read_full(fd, &vlen, 8) || vlen > 1ull << 32) break;
+    std::string val(vlen, '\0');
+    if (vlen && op != 3 && !read_full(fd, &val[0], vlen)) break;
+
+    int64_t status = 0;
+    std::string payload;
+    if (op == 1) {  // SET
+      std::lock_guard<std::mutex> g(st->mu);
+      st->kv[key] = val;
+      st->cv.notify_all();
+    } else if (op == 2) {  // GET (non-blocking; -1 if missing)
+      std::lock_guard<std::mutex> g(st->mu);
+      auto it = st->kv.find(key);
+      if (it == st->kv.end()) {
+        status = -1;
+      } else {
+        payload = it->second;
+        status = (int64_t)payload.size();
+      }
+    } else if (op == 3) {  // ADD vlen as signed delta; returns new value
+      std::lock_guard<std::mutex> g(st->mu);
+      int64_t cur = 0;
+      auto it = st->kv.find(key);
+      if (it != st->kv.end()) cur = std::stoll(it->second);
+      cur += (int64_t)vlen;
+      st->kv[key] = std::to_string(cur);
+      st->cv.notify_all();
+      status = cur;
+    } else if (op == 4) {  // WAIT until key exists, then return value
+      std::unique_lock<std::mutex> lk(st->mu);
+      st->cv.wait(lk, [&] {
+        return st->stop.load() || st->kv.count(key) > 0;
+      });
+      if (st->stop.load() && !st->kv.count(key)) {
+        status = -1;
+      } else {
+        payload = st->kv[key];
+        status = (int64_t)payload.size();
+      }
+    } else if (op == 5) {  // DELETE
+      std::lock_guard<std::mutex> g(st->mu);
+      status = (int64_t)st->kv.erase(key);
+    } else {
+      break;
+    }
+    if (!write_full(fd, &status, 8)) break;
+    if (status > 0 && (op == 2 || op == 4)) {
+      if (!write_full(fd, payload.data(), payload.size())) break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a server on port (0 = ephemeral). Returns handle; *out_port gets
+// the bound port.
+void* ptq_store_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  if (out_port) *out_port = ntohs(addr.sin_port);
+
+  StoreState* st = new StoreState();
+  st->listen_fd = fd;
+  st->accept_thread = std::thread([st] {
+    for (;;) {
+      int cfd = ::accept(st->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen_fd closed => shutdown
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(st->mu);
+      st->conns.emplace_back(serve_conn, st, cfd);
+    }
+  });
+  return st;
+}
+
+void ptq_store_server_stop(void* handle) {
+  StoreState* st = reinterpret_cast<StoreState*>(handle);
+  st->stop.store(true);
+  {
+    std::lock_guard<std::mutex> g(st->mu);
+    st->cv.notify_all();
+  }
+  ::shutdown(st->listen_fd, SHUT_RDWR);
+  ::close(st->listen_fd);
+  if (st->accept_thread.joinable()) st->accept_thread.join();
+  std::vector<std::thread> conns;
+  {
+    std::lock_guard<std::mutex> g(st->mu);
+    conns.swap(st->conns);
+  }
+  for (auto& t : conns)
+    if (t.joinable()) t.detach();  // blocked conns die with process
+  delete st;
+}
+
+// ---- client ----
+
+void* ptq_store_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  // retry-connect within timeout (server may start later)
+  int waited = 0;
+  while (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd);
+    if (waited >= timeout_ms) return nullptr;
+    usleep(100 * 1000);
+    waited += 100;
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return reinterpret_cast<void*>(static_cast<intptr_t>(fd + 1));
+}
+
+static int cfd_of(void* h) {
+  return (int)(reinterpret_cast<intptr_t>(h) - 1);
+}
+
+static bool send_req(int fd, uint8_t op, const char* key, uint32_t klen,
+                     const uint8_t* val, uint64_t vlen) {
+  std::string buf;
+  buf.push_back((char)op);
+  buf.append((const char*)&klen, 4);
+  buf.append(key, klen);
+  buf.append((const char*)&vlen, 8);
+  if (val && vlen) buf.append((const char*)val, vlen);
+  return write_full(fd, buf.data(), buf.size());
+}
+
+int64_t ptq_store_set(void* h, const char* key, const uint8_t* val,
+                      uint64_t vlen) {
+  int fd = cfd_of(h);
+  if (!send_req(fd, 1, key, (uint32_t)strlen(key), val, vlen)) return -1;
+  int64_t status;
+  if (!read_full(fd, &status, 8)) return -1;
+  return status;
+}
+
+// GET/WAIT: returns len and fills buf up to cap; -1 missing/err, -2 buf
+// too small (value bytes are drained and discarded).
+static int64_t get_like(void* h, uint8_t op, const char* key, uint8_t* buf,
+                        uint64_t cap) {
+  int fd = cfd_of(h);
+  if (!send_req(fd, op, key, (uint32_t)strlen(key), nullptr, 0)) return -1;
+  int64_t status;
+  if (!read_full(fd, &status, 8)) return -1;
+  if (status <= 0) return status;
+  if ((uint64_t)status > cap) {
+    std::vector<uint8_t> sink(status);
+    read_full(fd, sink.data(), status);
+    return -2;
+  }
+  if (!read_full(fd, buf, status)) return -1;
+  return status;
+}
+
+int64_t ptq_store_get(void* h, const char* key, uint8_t* buf, uint64_t cap) {
+  return get_like(h, 2, key, buf, cap);
+}
+
+int64_t ptq_store_wait(void* h, const char* key, uint8_t* buf, uint64_t cap) {
+  return get_like(h, 4, key, buf, cap);
+}
+
+int64_t ptq_store_add(void* h, const char* key, int64_t delta) {
+  int fd = cfd_of(h);
+  if (!send_req(fd, 3, key, (uint32_t)strlen(key), nullptr,
+                (uint64_t)delta))
+    return INT64_MIN;
+  int64_t status;
+  if (!read_full(fd, &status, 8)) return INT64_MIN;
+  return status;
+}
+
+int64_t ptq_store_delete(void* h, const char* key) {
+  int fd = cfd_of(h);
+  if (!send_req(fd, 5, key, (uint32_t)strlen(key), nullptr, 0)) return -1;
+  int64_t status;
+  if (!read_full(fd, &status, 8)) return -1;
+  return status;
+}
+
+void ptq_store_disconnect(void* h) { ::close(cfd_of(h)); }
+
+}  // extern "C"

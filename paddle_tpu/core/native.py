@@ -1,0 +1,140 @@
+"""ctypes loader for the native runtime library (csrc/libpaddle_tpu_rt.so).
+
+Reference analog: the pybind layer (paddle/fluid/pybind) loading libpaddle —
+here the runtime pieces that must be native (shared-memory queue, TCPStore)
+live in a small C++ lib; the compute path needs no bindings because it is
+jax/XLA. Builds on demand with `make -C csrc` when the .so is missing and a
+toolchain exists; callers must handle `lib() is None` (pure-Python
+fallbacks keep every feature usable).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libpaddle_tpu_rt.so"))
+_LOCK = threading.Lock()
+_LIB = [None, False]  # (handle, attempted)
+
+
+def _configure(lib):
+    c = ctypes
+    lib.ptq_shm_queue_open.restype = c.c_void_p
+    lib.ptq_shm_queue_open.argtypes = [c.c_char_p, c.c_uint64, c.c_uint64,
+                                       c.c_int]
+    lib.ptq_shm_queue_push.restype = c.c_int
+    lib.ptq_shm_queue_push.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.ptq_shm_queue_pop.restype = c.c_int64
+    lib.ptq_shm_queue_pop.argtypes = [c.c_void_p, c.c_void_p, c.c_uint64]
+    lib.ptq_shm_queue_peek_size.restype = c.c_int64
+    lib.ptq_shm_queue_peek_size.argtypes = [c.c_void_p]
+    lib.ptq_shm_queue_count.restype = c.c_uint64
+    lib.ptq_shm_queue_count.argtypes = [c.c_void_p]
+    lib.ptq_shm_queue_close.argtypes = [c.c_void_p]
+    lib.ptq_shm_queue_free.argtypes = [c.c_void_p]
+
+    lib.ptq_store_server_start.restype = c.c_void_p
+    lib.ptq_store_server_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.ptq_store_server_stop.argtypes = [c.c_void_p]
+    lib.ptq_store_connect.restype = c.c_void_p
+    lib.ptq_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.ptq_store_set.restype = c.c_int64
+    lib.ptq_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p,
+                                  c.c_uint64]
+    lib.ptq_store_get.restype = c.c_int64
+    lib.ptq_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                  c.c_uint64]
+    lib.ptq_store_wait.restype = c.c_int64
+    lib.ptq_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                   c.c_uint64]
+    lib.ptq_store_add.restype = c.c_int64
+    lib.ptq_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.ptq_store_delete.restype = c.c_int64
+    lib.ptq_store_delete.argtypes = [c.c_void_p, c.c_char_p]
+    lib.ptq_store_disconnect.argtypes = [c.c_void_p]
+    return lib
+
+
+def lib():
+    """The loaded native lib, or None if unavailable."""
+    with _LOCK:
+        if _LIB[1]:
+            return _LIB[0]
+        _LIB[1] = True
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(["make", "-C", os.path.abspath(_CSRC)],
+                               capture_output=True, timeout=120, check=True)
+            except Exception:
+                return None
+        try:
+            _LIB[0] = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _LIB[0] = None
+        return _LIB[0]
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+class ShmQueue:
+    """Bounded blocking queue over POSIX shared memory (bytes payloads).
+
+    Owner creates; workers attach by name after fork/spawn.
+    """
+
+    def __init__(self, name: str, n_slots: int = 8,
+                 slot_bytes: int = 64 << 20, owner: bool = True):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native runtime library unavailable")
+        self._lib = L
+        self.name = name
+        self.slot_bytes = slot_bytes
+        self._h = L.ptq_shm_queue_open(name.encode(), n_slots, slot_bytes,
+                                       1 if owner else 0)
+        if not self._h:
+            raise OSError(f"shm_queue_open failed for {name!r}")
+        self._owner = owner
+
+    def put(self, data: bytes):
+        rc = self._lib.ptq_shm_queue_push(self._h, data, len(data))
+        if rc == -2:
+            raise ValueError(
+                f"item of {len(data)} bytes exceeds slot size "
+                f"{self.slot_bytes}")
+        if rc != 0:
+            raise EOFError("queue closed")
+
+    def get(self) -> bytes:
+        size = self._lib.ptq_shm_queue_peek_size(self._h)
+        if size < 0:
+            raise EOFError("queue closed and drained")
+        buf = ctypes.create_string_buffer(size or 1)
+        n = self._lib.ptq_shm_queue_pop(self._h, buf, size or 1)
+        if n < 0:
+            raise EOFError("queue closed and drained")
+        return buf.raw[:n]
+
+    def qsize(self) -> int:
+        return int(self._lib.ptq_shm_queue_count(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.ptq_shm_queue_close(self._h)
+
+    def free(self):
+        if self._h:
+            self._lib.ptq_shm_queue_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            if self._owner:
+                self.free()
+        except Exception:
+            pass

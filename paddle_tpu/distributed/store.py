@@ -1,0 +1,185 @@
+"""TCPStore — rendezvous key-value store for multi-host bootstrap.
+
+Reference analog: phi::distributed::TCPStore
+(paddle/phi/core/distributed/store/tcp_store.h:117) used by ProcessGroup
+creation to exchange NCCL unique ids. Here it bootstraps
+jax.distributed-style coordination and carries small rendezvous blobs
+(coordinator address, per-rank host info). Backed by the native C++
+server/client (csrc/tcp_store.cc); a pure-Python fallback covers
+toolchain-free environments.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..core import native
+
+__all__ = ["TCPStore"]
+
+
+class _NativeStore:
+    def __init__(self, host, port, is_master, timeout):
+        L = native.lib()
+        self._lib = L
+        self._server = None
+        import ctypes
+        if is_master:
+            out_port = ctypes.c_int(0)
+            self._server = L.ptq_store_server_start(
+                port, ctypes.byref(out_port))
+            if not self._server:
+                raise OSError(f"TCPStore server failed to bind :{port}")
+            port = out_port.value
+        self.port = port
+        ip = socket.gethostbyname(host)
+        self._h = L.ptq_store_connect(ip.encode(), port,
+                                      int(timeout * 1000))
+        if not self._h:
+            raise TimeoutError(f"TCPStore connect to {host}:{port} failed")
+
+    def set(self, key: str, value: bytes):
+        if self._lib.ptq_store_set(self._h, key.encode(), value,
+                                   len(value)) < 0:
+            raise IOError("TCPStore.set failed")
+
+    def _get(self, fn, key):
+        import ctypes
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = fn(self._h, key.encode(), buf, cap)
+            if n == -2:
+                cap *= 16
+                continue
+            if n < 0:
+                return None
+            return buf.raw[:n]
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._get(self._lib.ptq_store_get, key)
+
+    def wait(self, key: str) -> bytes:
+        out = self._get(self._lib.ptq_store_wait, key)
+        if out is None:
+            raise TimeoutError(f"TCPStore.wait({key!r}) aborted")
+        return out
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = self._lib.ptq_store_add(self._h, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise IOError("TCPStore.add failed")
+        return int(v)
+
+    def delete(self, key: str) -> bool:
+        return self._lib.ptq_store_delete(self._h, key.encode()) > 0
+
+    def close(self):
+        if self._h:
+            self._lib.ptq_store_disconnect(self._h)
+            self._h = None
+        if self._server:
+            self._lib.ptq_store_server_stop(self._server)
+            self._server = None
+
+
+class _PyStore:
+    """In-process fallback with the same surface (single-host only)."""
+
+    _GLOBAL = {}
+    _LOCK = threading.Lock()
+    _CV = threading.Condition(_LOCK)
+
+    def __init__(self, host, port, is_master, timeout):
+        self.port = port
+
+    def set(self, key, value):
+        with self._CV:
+            self._GLOBAL[key] = value
+            self._CV.notify_all()
+
+    def get(self, key):
+        with self._LOCK:
+            return self._GLOBAL.get(key)
+
+    def wait(self, key, timeout=300.0):
+        with self._CV:
+            ok = self._CV.wait_for(lambda: key in self._GLOBAL, timeout)
+            if not ok:
+                raise TimeoutError(f"wait({key!r}) timed out")
+            return self._GLOBAL[key]
+
+    def add(self, key, delta=1):
+        with self._CV:
+            cur = int(self._GLOBAL.get(key, b"0")) + delta
+            self._GLOBAL[key] = str(cur).encode()
+            self._CV.notify_all()
+            return cur
+
+    def delete(self, key):
+        with self._LOCK:
+            return self._GLOBAL.pop(key, None) is not None
+
+    def close(self):
+        pass
+
+
+class TCPStore:
+    """paddle-compatible surface: TCPStore(host, port, is_master,
+    world_size, timeout). Values are bytes; helpers for python objects."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        self.host = host
+        self.world_size = world_size
+        if native.available():
+            self._impl = _NativeStore(host, port, is_master, timeout)
+        else:
+            self._impl = _PyStore(host, port, is_master, timeout)
+        self.port = self._impl.port
+        self.is_native = isinstance(self._impl, _NativeStore)
+
+    def set(self, key: str, value) -> None:
+        if not isinstance(value, (bytes, bytearray)):
+            value = pickle.dumps(value)
+        self._impl.set(key, bytes(value))
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._impl.get(key)
+
+    def wait(self, key: str) -> bytes:
+        return self._impl.wait(key)
+
+    def get_obj(self, key: str):
+        raw = self._impl.wait(key)
+        return pickle.loads(raw)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return self._impl.add(key, delta)
+
+    def delete_key(self, key: str) -> bool:
+        return self._impl.delete(key)
+
+    def barrier(self, name: str = "barrier", rank: int = 0,
+                poll_s: float = 0.01):
+        """All world_size ranks block until everyone arrived."""
+        n = self.add(f"__bar__{name}", 1)
+        if n == self.world_size:
+            self.set(f"__bar_done__{name}", b"1")
+        self.wait(f"__bar_done__{name}")
+
+    def close(self):
+        self._impl.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

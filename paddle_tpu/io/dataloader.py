@@ -67,12 +67,35 @@ def _to_tensor_tree(data):
 
 
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 num_workers, base_seed, init_fn=None):
+                 num_workers, base_seed, init_fn=None, shm_cfg=None):
     _worker_info.info = WorkerInfo(worker_id, num_workers, dataset,
                                    base_seed + worker_id)
     np.random.seed(base_seed + worker_id)
     if init_fn is not None:
         init_fn(worker_id)
+    shm = None
+    slot_bytes = 0
+    if shm_cfg is not None:
+        from ..core.native import ShmQueue
+        name, slot_bytes, n_slots = shm_cfg
+        try:
+            shm = ShmQueue(name, n_slots=n_slots, slot_bytes=slot_bytes,
+                           owner=False)
+        except Exception:
+            shm = None
+
+    def emit(payload):
+        # native shm ring when attached; batches bigger than a slot take
+        # the mp.Queue path behind a marker so pop order stays defined
+        if shm is not None:
+            import pickle
+            raw = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            if len(raw) <= slot_bytes:
+                shm.put(raw)
+                return
+            shm.put(pickle.dumps(("__big__", payload[0])))
+        data_queue.put(payload)
+
     while True:
         item = index_queue.get()
         if item is None:
@@ -80,9 +103,9 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
         batch_id, indices = item
         try:
             samples = [dataset[i] for i in indices]
-            data_queue.put((batch_id, collate_fn(samples), None))
+            emit((batch_id, collate_fn(samples), None))
         except Exception as e:  # propagate worker errors
-            data_queue.put((batch_id, None, e))
+            emit((batch_id, None, e))
 
 
 class DataLoader:
@@ -99,6 +122,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self._is_iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -146,16 +170,49 @@ class DataLoader:
         data_queue = ctx.Queue()
         workers = []
         base_seed = np.random.randint(0, 2 ** 31 - 1)
+
+        # native shared-memory transport (reference: C++ blocking_queue +
+        # shared-mem tensor transport) when built; mp.Queue otherwise
+        shm = None
+        shm_cfg = None
+        if self.use_shared_memory:
+            from ..core import native
+            if native.available():
+                import os as _os
+                name = f"/ptq_dl_{_os.getpid()}_{id(self) & 0xffffff}"
+                slot_bytes = 32 << 20
+                n_slots = max(4, self.num_workers * self.prefetch_factor)
+                try:
+                    shm = native.ShmQueue(name, n_slots=n_slots,
+                                          slot_bytes=slot_bytes, owner=True)
+                    shm_cfg = (name, slot_bytes, n_slots)
+                except Exception:
+                    shm = None
+
         for wid in range(self.num_workers):
             iq = ctx.Queue()
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, iq, data_queue, self.collate_fn, wid,
-                      self.num_workers, base_seed, self.worker_init_fn),
+                      self.num_workers, base_seed, self.worker_init_fn,
+                      shm_cfg),
                 daemon=True)
             w.start()
             workers.append(w)
             index_queues.append(iq)
+
+        def recv():
+            if shm is not None:
+                import pickle
+                payload = pickle.loads(shm.get())
+                if isinstance(payload, tuple) and len(payload) == 2 \
+                        and payload[0] == "__big__":
+                    return data_queue.get(
+                        timeout=self.timeout if self.timeout else None)
+                return payload
+            return data_queue.get(
+                timeout=self.timeout if self.timeout else None)
+
         try:
             batches = list(self.batch_sampler)
             # dispatch round-robin with bounded in-flight count
@@ -170,8 +227,7 @@ class DataLoader:
                         (next_dispatch, batches[next_dispatch]))
                     next_dispatch += 1
                     inflight += 1
-                bid, data, err = data_queue.get(
-                    timeout=self.timeout if self.timeout else None)
+                bid, data, err = recv()
                 if err is not None:
                     raise err
                 inflight -= 1
@@ -186,3 +242,6 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if shm is not None:
+                shm.close()
+                shm.free()
