@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed parity."""
+from . import models

@@ -1,0 +1,2 @@
+from .gate import NaiveGate, GShardGate, SwitchGate, BaseGate
+from .moe_layer import MoELayer

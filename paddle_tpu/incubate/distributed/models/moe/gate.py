@@ -1,0 +1,86 @@
+"""MoE gates — routing policies.
+
+Reference analog: python/paddle/incubate/distributed/models/moe/gate/
+(naive_gate.py, gshard_gate.py, switch_gate.py). Each gate maps token
+activations [T, H] -> (topk gate values [T, K], expert indices [T, K],
+aux loss scalar).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....core.tensor import Tensor, apply_op
+from .....nn.layer.layers import Layer
+from ..... import nn
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def _gshard_aux(probs, top1_idx, num_experts):
+    """GShard load-balancing loss: E * sum(mean_prob * mean_assignment)."""
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1_idx, num_experts,
+                                 dtype=jnp.float32), axis=0)
+    return num_experts * jnp.sum(me * ce)
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.linear = nn.Linear(d_model, num_experts, bias_attr=False)
+
+    def routing(self, logits):
+        """array [T, E] -> (gate_vals [T,K], idx [T,K], aux) arrays."""
+        raise NotImplementedError
+
+    def forward(self, x: Tensor):
+        logits = self.linear(x)
+
+        def _route(lg):
+            return self.routing(lg.astype(jnp.float32))
+        val, idx, aux = apply_op(_route, logits, op_name="moe_gate",
+                                 n_outs=3)
+        idx.stop_gradient = True
+        return val, idx, aux
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no aux loss (reference naive_gate.py)."""
+
+    def routing(self, logits):
+        probs = jax.nn.softmax(logits, axis=-1)
+        val, idx = lax.top_k(probs, self.top_k)
+        val = val / jnp.sum(val, axis=-1, keepdims=True)
+        return val, idx, jnp.zeros((), jnp.float32)
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard load-balance aux loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2):
+        super().__init__(d_model, num_experts, top_k)
+
+    def routing(self, logits):
+        probs = jax.nn.softmax(logits, axis=-1)
+        val, idx = lax.top_k(probs, self.top_k)
+        val = val / jnp.sum(val, axis=-1, keepdims=True)
+        aux = _gshard_aux(probs, idx[:, 0], self.num_experts)
+        return val, idx, aux
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch-transformer gate (switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=1):
+        super().__init__(d_model, num_experts, 1)
+
+    def routing(self, logits):
+        probs = jax.nn.softmax(logits, axis=-1)
+        val, idx = lax.top_k(probs, 1)
+        aux = _gshard_aux(probs, idx[:, 0], self.num_experts)
+        return val, idx, aux

@@ -194,6 +194,32 @@ class Layer:
                 seen.add(id(p))
                 yield (f"{lp}.{name}" if lp else name), p
 
+    def functional_forward(self, param_arrays, *input_arrays, **kwargs):
+        """Run forward() with parameters substituted by `param_arrays`
+        (same order as self.parameters()), on raw jax arrays, returning
+        raw arrays. Pure in the arrays — the bridge that lets eager
+        Layers run under vmap/scan/jit (e.g. batched MoE experts)."""
+        from ...core.tensor import no_grad
+        params = self.parameters()
+        if len(param_arrays) != len(params):
+            raise ValueError(
+                f"expected {len(params)} param arrays, got "
+                f"{len(param_arrays)}")
+        old = [p._array for p in params]
+        try:
+            for p, a in zip(params, param_arrays):
+                p._array = a
+            with no_grad():
+                out = self.forward(*[Tensor(a) for a in input_arrays],
+                                   **kwargs)
+            if isinstance(out, (tuple, list)):
+                return type(out)(o._array if isinstance(o, Tensor) else o
+                                 for o in out)
+            return out._array if isinstance(out, Tensor) else out
+        finally:
+            for p, o in zip(params, old):
+                p._array = o
+
     def buffers(self, include_sublayers=True) -> List[Tensor]:
         return [b for _, b in self.named_buffers(
             include_sublayers=include_sublayers)]
