@@ -47,6 +47,7 @@ from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import profiler  # noqa: E402
 from . import incubate  # noqa: E402
+from . import inference  # noqa: E402
 from . import hapi  # noqa: E402
 from . import device  # noqa: E402
 from . import static  # noqa: E402

@@ -1,0 +1,142 @@
+"""Inference API — the serving path.
+
+Reference analog: paddle/fluid/inference/ AnalysisPredictor
+(api/analysis_predictor.h:95) + python/paddle/inference/__init__.py
+(Config, create_predictor, Tensor handles). There the saved ProgramDesc is
+re-analyzed by an IR pass pipeline and executed op-by-op (TensorRT
+subgraphs etc.); here the jit.save artifact is an AOT-exported StableHLO
+module — XLA already did the fusion/optimization work at export time — and
+the predictor simply binds inputs, runs the compiled executable, and
+returns host arrays. Mixed precision / device placement are jit-time
+properties of the exported function.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor as _EagerTensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """paddle.inference.Config parity (the knobs that matter on TPU)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._memory_optimized = True
+        self._ir_optim = True
+        self._device = None
+
+    # -- device selection ---------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = ("gpu", device_id)  # alias: the accelerator chip
+
+    def enable_xpu(self, *a, **k):
+        self._device = ("xpu", 0)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    # -- graph opts (XLA equivalents are on by default) ----------------------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optimized = flag
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+
+class PredictorTensor:
+    """Zero-copy-style I/O handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return self._value
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    """Loads a jit.save artifact and runs it (AnalysisPredictor analog)."""
+
+    def __init__(self, config: Config):
+        from ..jit.api import load as jit_load
+        self._config = config
+        self._layer = jit_load(config._prefix)
+        meta_path = config._prefix + ".meta"
+        self._input_names: List[str] = []
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            n = len(meta.get("input_specs", []))
+            self._input_names = [f"input_{i}" for i in range(n)]
+        self._inputs: Dict[str, PredictorTensor] = {
+            n: PredictorTensor(n) for n in self._input_names}
+        self._outputs: Dict[str, PredictorTensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs.setdefault(name, PredictorTensor(name))
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Either positional numpy inputs (returns list of numpy), or the
+        handle protocol (copy_from_cpu -> run() -> copy_to_cpu)."""
+        if inputs is None:
+            inputs = [self._inputs[n]._value for n in self._input_names]
+        outs = self._layer(*inputs)
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        arrays = [np.asarray(o._array) if isinstance(o, _EagerTensor)
+                  else np.asarray(o) for o in outs]
+        self._outputs = {}
+        for i, a in enumerate(arrays):
+            h = PredictorTensor(f"output_{i}")
+            h._value = a
+            self._outputs[f"output_{i}"] = h
+        return arrays
+
+    def clone(self):
+        return Predictor(self._config)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,69 @@
+"""jit.to_static / jit.save / jit.load / inference.Predictor round trips.
+
+Reference analogs: jit/api.py:222 to_static, :773 save;
+inference AnalysisPredictor serving path.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import InputSpec
+
+
+def _net():
+    paddle.seed(42)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_to_static_matches_eager():
+    net = _net()
+    x = paddle.randn([3, 8])
+    eager = net(x).numpy()
+    snet = paddle.jit.to_static(net)
+    static = snet(x).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_load_roundtrip():
+    net = _net()
+    x = paddle.randn([2, 8])
+    ref = net(x).numpy()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([-1, 8], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+    loaded = paddle.jit.load(path)
+    out = loaded(x.numpy()[:1])
+    got = out.numpy() if not isinstance(out, (list, tuple)) \
+        else out[0].numpy()
+    np.testing.assert_allclose(got, ref[:1], rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_serving_path():
+    from paddle_tpu import inference
+    net = _net()
+    x = np.random.default_rng(0).standard_normal((1, 8)).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "serving")
+    paddle.jit.save(net, path, input_spec=[InputSpec([-1, 8], "float32")])
+
+    config = inference.Config(path + ".pdmodel")
+    predictor = inference.create_predictor(config)
+    names = predictor.get_input_names()
+    assert names == ["input_0"]
+    predictor.get_input_handle("input_0").copy_from_cpu(x)
+    predictor.run()
+    out_names = predictor.get_output_names()
+    got = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # positional API too
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
