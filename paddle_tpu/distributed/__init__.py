@@ -16,8 +16,12 @@ from .shard import (shard_tensor, shard_op, shard_layer,
                     with_sharding_constraint, shard_params, replicate_params)
 from .random import RNGStatesTracker, get_rng_state_tracker, \
     model_parallel_random_seed
+from .recompute import recompute, recompute_sequential
 from . import fleet
 from . import sharding
+from . import pipeline
+from . import rpc
+from . import auto_parallel
 from .launch_utils import spawn, launch
 
 __all__ = [
@@ -31,4 +35,5 @@ __all__ = [
     "shard_op", "shard_layer", "with_sharding_constraint", "shard_params",
     "replicate_params", "RNGStatesTracker", "get_rng_state_tracker",
     "model_parallel_random_seed", "fleet", "sharding", "spawn", "launch",
+    "recompute", "recompute_sequential", "pipeline", "rpc", "auto_parallel",
 ]

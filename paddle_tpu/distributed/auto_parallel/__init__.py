@@ -1,0 +1,33 @@
+"""Semi-automatic parallel — the auto_parallel namespace.
+
+Reference analog: python/paddle/distributed/auto_parallel/ — ProcessMesh
+(process_mesh.py:45), shard_tensor/shard_op markers (interface.py:28/:108),
+Strategy (strategy.py), Engine (engine.py:57, fit:812) whose pipeline is
+build → plan (Completer propagates dist attrs, completion.py:928) →
+parallel (Partitioner splits the program per rank, Resharder inserts comm)
+→ init (create comm groups).
+
+TPU-native design: the plan/partition/reshard stages ARE XLA's GSPMD
+partitioner (SURVEY.md §3.6 — the reference hand-implements exactly this
+shape on ProgramDesc). So the Engine here only has to (1) place parameters
+on the mesh per their annotations, (2) shard the data batch over the "dp"
+axis, (3) jit one training step — everything the reference's Completer/
+Partitioner/Resharder do is done by the compiler from those annotations.
+"""
+from __future__ import annotations
+
+from .placements import Shard, Replicate, Partial, to_partition_spec
+from .strategy import Strategy
+from .engine import Engine
+from ..mesh import ProcessMesh, get_mesh
+from ..shard import (shard_tensor, shard_op, shard_layer,
+                     with_sharding_constraint, shard_params,
+                     replicate_params)
+from ..recompute import recompute
+
+__all__ = [
+    "ProcessMesh", "Engine", "Strategy",
+    "Shard", "Replicate", "Partial", "to_partition_spec",
+    "shard_tensor", "shard_op", "shard_layer", "with_sharding_constraint",
+    "shard_params", "replicate_params", "recompute", "get_mesh",
+]

@@ -1,0 +1,106 @@
+"""Placement types describing how a tensor maps onto a ProcessMesh.
+
+Reference analog: auto_parallel's dist_attr dims_mapping
+(paddle/fluid/distributed/auto_parallel/dist_attr.h) — dims_mapping[i] = j
+means tensor dim i is split over mesh dim j, -1 means replicated. The
+Shard/Replicate/Partial vocabulary is the modern spelling of the same
+thing; `to_partition_spec` lowers a placements list (one entry per MESH
+dim, reference convention) to the jax PartitionSpec GSPMD consumes.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial",
+           "to_partition_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Tensor dim `dim` is split across the corresponding mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction state (reference: partial status in dist_attr).
+    GSPMD materialises/reduces partials automatically; tensors annotated
+    Partial are treated as replicated at placement time."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return (isinstance(other, Partial)
+                and other.reduce_type == self.reduce_type)
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def to_partition_spec(placements, mesh, ndim=None):
+    """placements[i] describes how mesh axis i touches the tensor
+    (reference convention: one placement per mesh dimension). Returns the
+    PartitionSpec (one entry per TENSOR dimension) GSPMD wants."""
+    axis_names = list(mesh.axis_names) if hasattr(mesh, "axis_names") \
+        else list(mesh.dim_names)
+    if ndim is None:
+        ndim = 1 + max((p.dim for p in placements
+                        if isinstance(p, Shard)), default=-1)
+    dims = [None] * ndim
+    for axis_name, p in zip(axis_names, placements):
+        if isinstance(p, Shard):
+            if dims[p.dim] is not None:
+                # two mesh axes on one tensor dim → tuple (nested sharding)
+                prev = dims[p.dim]
+                dims[p.dim] = (prev if isinstance(prev, tuple)
+                               else (prev,)) + (axis_name,)
+            else:
+                dims[p.dim] = axis_name
+    return PartitionSpec(*dims)

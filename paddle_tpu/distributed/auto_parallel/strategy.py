@@ -1,0 +1,94 @@
+"""auto_parallel.Strategy — parallelization/optimization knobs.
+
+Reference analog: python/paddle/distributed/auto_parallel/strategy.py
+(config groups defined by constants.py: amp, recompute, sharding,
+gradient_merge, pipeline, qat, tuning). Field names kept identical so user
+configs port unchanged; each group notes what it means on TPU.
+"""
+from __future__ import annotations
+
+__all__ = ["Strategy"]
+
+
+class _Config:
+    _fields = {}
+
+    def __init__(self, **kwargs):
+        for k, v in self._fields.items():
+            setattr(self, k, v)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._fields}
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._fields)
+        return f"{type(self).__name__}({inner})"
+
+
+class AMPConfig(_Config):
+    """On TPU: dtype='bfloat16' needs no loss scaling; fp16 keeps the
+    scaler for parity (amp/GradScaler)."""
+    _fields = dict(enable=False, dtype="bfloat16", level="o1",
+                   init_loss_scaling=32768.0, custom_white_list=[],
+                   custom_black_list=[], use_fp16_guard=False,
+                   use_bf16_guard=False)
+
+
+class RecomputeConfig(_Config):
+    """Lowered to jax.checkpoint regions (distributed/recompute.py)."""
+    _fields = dict(enable=False, checkpoints=None, no_recompute_segments=[],
+                   enable_tuning=False)
+
+
+class ShardingConfig(_Config):
+    """ZeRO: stage 1/2 = optimizer-state (+grad) sharding over 'dp' via
+    PartitionSpec; stage 3 = param sharding (GSPMD gathers per-use)."""
+    _fields = dict(enable=False, stage=1, degree=8,
+                   enable_tuning=False, overlap_grad_comm=True)
+
+
+class GradientMergeConfig(_Config):
+    _fields = dict(enable=False, k_steps=1, avg=True)
+
+
+class PipelineConfig(_Config):
+    _fields = dict(enable=False, schedule_mode="1F1B", micro_batch_size=1,
+                   accumulate_steps=1)
+
+
+class QATConfig(_Config):
+    _fields = dict(enable=False, channel_wise_abs_max=True, weight_bits=8,
+                   activation_bits=8, not_quant_pattern=["skip_quant"])
+
+
+class TuningConfig(_Config):
+    _fields = dict(enable=False, profile_start_step=1, profile_end_step=1,
+                   run_after_tuning=True, verbose=True)
+
+
+class Strategy(_Config):
+    """reference: strategy.py Strategy — holds one config object per
+    optimization; `auto_mode` "semi" means user annotations + automatic
+    propagation (on TPU: annotations + GSPMD)."""
+
+    _fields = dict(auto_mode="semi", seed=None, split_data=True,
+                   data_parallel=True)
+
+    def __init__(self, config=None):
+        super().__init__(**(config or {}))
+        self.amp = AMPConfig()
+        self.recompute = RecomputeConfig()
+        self.sharding = ShardingConfig()
+        self.gradient_merge = GradientMergeConfig()
+        self.pipeline = PipelineConfig()
+        self.qat = QATConfig()
+        self.tuning = TuningConfig()
+
+    def to_dict(self):
+        d = super().to_dict()
+        for g in ("amp", "recompute", "sharding", "gradient_merge",
+                  "pipeline", "qat", "tuning"):
+            d[g] = getattr(self, g).to_dict()
+        return d

@@ -18,14 +18,17 @@ from ..mesh import init_mesh, get_topology, HybridTopology
 from ..parallel import init_parallel_env, DataParallel
 from ..collective import get_rank, get_world_size
 from . import mp_layers
+from . import utils
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                         RowParallelLinear, ParallelCrossEntropy)
+from .. import auto_parallel as auto  # `from fleet import auto` parity
 
-__all__ = ["init", "DistributedStrategy", "distributed_model",
+__all__ = ["init", "Fleet", "DistributedStrategy", "distributed_model",
             "distributed_optimizer", "get_hybrid_communicate_group",
             "worker_index", "worker_num", "is_first_worker",
             "VocabParallelEmbedding", "ColumnParallelLinear",
-            "RowParallelLinear", "ParallelCrossEntropy", "mp_layers"]
+            "RowParallelLinear", "ParallelCrossEntropy", "mp_layers",
+            "utils", "auto"]
 
 
 class DistributedStrategy:
@@ -88,6 +91,51 @@ def distributed_optimizer(optimizer, strategy=None):
     already global under GSPMD (grads are full logical tensors in trace),
     so the wrapper is the optimizer itself."""
     return optimizer
+
+
+class Fleet:
+    """reference: fleet/fleet.py:101 — the stateful facade object. The
+    module-level `fleet.init` etc. mirror paddle, where a singleton Fleet
+    instance backs the module functions."""
+
+    def __init__(self):
+        self._strategy = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        init(role_maker, is_collective, strategy, log_level)
+        self._strategy = _FLEET_STATE["strategy"]
+        return self
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    @property
+    def worker_index(self):
+        return get_rank()
+
+    @property
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def get_hybrid_communicate_group(self):
+        return get_hybrid_communicate_group()
+
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        raise NotImplementedError(
+            "PS-mode persistables are out of scope on TPU; use "
+            "paddle_tpu.save(model.state_dict(), path)")
 
 
 def worker_index():

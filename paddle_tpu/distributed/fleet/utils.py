@@ -1,0 +1,50 @@
+"""fleet.utils — recompute + hybrid-parallel helpers.
+
+Reference analog: python/paddle/distributed/fleet/utils/__init__.py
+(exports `recompute`) and fleet/utils/hybrid_parallel_util.py
+(fused_allreduce_gradients:206).
+"""
+from __future__ import annotations
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential",
+           "fused_allreduce_gradients", "LocalFS"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """reference: hybrid_parallel_util.py:206 — DP bucketed grad allreduce.
+    Under GSPMD the gradients computed inside the jit'ed step are already
+    globally reduced over the 'dp' axis (psum inserted by the partitioner),
+    so this is an intentional no-op kept for call-site parity."""
+    return None
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS (HDFS client is out of scope
+    on TPU pods; GCS/local posix is the native storage)."""
+
+    def ls_dir(self, path):
+        import os
+        entries = os.listdir(path)
+        dirs = [e for e in entries
+                if os.path.isdir(os.path.join(path, e))]
+        files = [e for e in entries
+                 if os.path.isfile(os.path.join(path, e))]
+        return dirs, files
+
+    def is_exist(self, path):
+        import os
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        import os
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        import os
+        import shutil
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)

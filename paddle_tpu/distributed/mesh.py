@@ -115,6 +115,8 @@ class ProcessMesh:
         if mesh is not None:
             arr = np.asarray(mesh)
         else:
+            if process_ids is None:
+                process_ids = np.arange(int(np.prod(shape)))
             arr = np.asarray(process_ids).reshape(shape)
         self._shape = list(arr.shape)
         self._process_ids = arr.reshape(-1).tolist()

@@ -1,0 +1,150 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference analog: python/paddle/distributed/fleet/recompute/recompute.py
+(`RecomputeFunction(PyLayer)` at :69 — drops activations in forward, replays
+the forward inside backward with preserved RNG state) and
+recompute_hybrid.py (the hybrid-parallel variant that additionally
+partitions saved activations over the mp group).
+
+TPU-native design: `jax.checkpoint` (remat) IS the recompute engine — the
+wrapped computation is re-traced into the backward pass and XLA schedules
+the replay, so there is no PyLayer, no RNG stashing (the RNG keys consumed
+by dropout etc. are *inputs* to the traced computation; the remat replay
+re-executes the identical jaxpr with identical keys, which is what
+`preserve_rng_state=True` means in the reference), and no manual activation
+partitioning (saved residuals inherit the sharding of the live values).
+
+The eager-facade integration: gradients must flow not only to the explicit
+tensor arguments but to the parameters the wrapped callable closes over
+(the reference gets this for free from its global autograd graph). We lift
+closed-over `Layer` parameters into explicit inputs of the rematerialised
+function so the tape records them as edges — including layers reachable
+through plain-function closures (the `create_custom_forward(block)` paddle
+idiom).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+
+from ..core.tensor import Tensor, apply_op, no_grad, _as_array
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _closure_params(function: Callable):
+    """Trainable parameters reachable from the callable: a Layer, a bound
+    method of a Layer, or a plain function/lambda whose closure cells (or
+    defaults) hold Layers/parameters — the common
+    `recompute(create_custom_forward(block), x)` pattern."""
+    from ..nn.layer.layers import Layer
+
+    seen_params = {}
+    seen_objs = set()
+
+    def visit(obj, depth=0):
+        if obj is None or id(obj) in seen_objs or depth > 3:
+            return
+        seen_objs.add(id(obj))
+        if isinstance(obj, Layer):
+            for p in obj.parameters():
+                if not p.stop_gradient:
+                    seen_params.setdefault(id(p), p)
+        elif isinstance(obj, Tensor):
+            if not obj.stop_gradient:
+                seen_params.setdefault(id(obj), obj)
+        elif callable(obj):
+            visit(getattr(obj, "__self__", None), depth + 1)
+            for cell in (getattr(obj, "__closure__", None) or ()):
+                try:
+                    visit(cell.cell_contents, depth + 1)
+                except ValueError:  # empty cell
+                    pass
+            for d in (getattr(obj, "__defaults__", None) or ()):
+                visit(d, depth + 1)
+        elif isinstance(obj, (list, tuple)):
+            for item in obj:
+                visit(item, depth + 1)
+
+    visit(function)
+    return list(seen_params.values())
+
+
+def _recompute_impl(function: Callable, params, args, kwargs):
+    """Single implementation: lift (tensor args + params) into inputs of a
+    jax.checkpoint-wrapped pure function and route through the tape."""
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    n_args = len(tensor_args)
+
+    def of_arrays(*arrays):
+        arg_arrays, param_arrays = arrays[:n_args], arrays[n_args:]
+        it = iter(arg_arrays)
+        rebuilt = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                   for a in args]
+        saved = [p._array for p in params]
+        for p, arr in zip(params, param_arrays):
+            p._array = arr
+        try:
+            with no_grad():
+                out = function(*rebuilt, **kwargs)
+        finally:
+            for p, arr in zip(params, saved):
+                p._array = arr
+        if isinstance(out, (tuple, list)):
+            return tuple(_as_array(o) for o in out)
+        return _as_array(out)
+
+    remat_fn = jax.checkpoint(of_arrays)
+    return apply_op(lambda *a: remat_fn(*[_as_array(x) for x in a]),
+                    *tensor_args, *params, op_name="recompute")
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """Run `function(*args, **kwargs)` without keeping its intermediate
+    activations; they are rematerialised during backward.
+
+    reference: fleet/recompute/recompute.py:69 (RecomputeFunction) and the
+    public `paddle.distributed.fleet.utils.recompute`.
+
+    `preserve_rng_state` is accepted for API parity and is always
+    effectively True (see module docstring); `use_reentrant` is accepted
+    and ignored (there is a single implementation).
+    """
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+    return _recompute_impl(function, _closure_params(function), args, kwargs)
+
+
+def recompute_sequential(ctx: dict, functions: Sequence[Callable], *args):
+    """Checkpoint a sequence of layers in `segments` chunks
+    (reference: later paddle's recompute_sequential; provided here because
+    segment-wise remat is the natural granularity on TPU — each segment
+    becomes one remat region XLA can schedule independently)."""
+    ctx = ctx or {}
+    segments = int(ctx.get("segments", 1))
+    functions = list(functions)
+    n = len(functions)
+    seg = max(1, n // max(1, segments))
+
+    def make_chunk(fns):
+        def chunk(*xs):
+            out = xs
+            for f in fns:
+                out = f(*out) if isinstance(out, tuple) else f(out)
+            return out
+        return chunk
+
+    out: Any = args
+    for start in range(0, n, seg):
+        fns = functions[start:start + seg]
+        params: list = []
+        pid = set()
+        for f in fns:
+            for p in _closure_params(f):
+                if id(p) not in pid:
+                    pid.add(id(p))
+                    params.append(p)
+        out_t = out if isinstance(out, tuple) else (out,)
+        out = _recompute_impl(make_chunk(fns), params, out_t, {})
+    return out

@@ -1,0 +1,219 @@
+"""paddle.distributed.rpc parity — a minimal peer-to-peer RPC layer.
+
+Reference analog: python/paddle/distributed/rpc/rpc.py (init_rpc:73,
+rpc_sync:141, rpc_async:179, shutdown:270, get_worker_info:299) backed by a
+brpc `RpcAgent` (paddle/fluid/distributed/rpc/rpc_agent.h).
+
+TPU-native design: TPU training traffic all rides XLA collectives, so RPC
+here serves the same *control-plane* role it does in the reference (actor
+coordination, parameter pulls, custom protocols) — not tensor transport.
+Implementation: each worker runs a `multiprocessing.connection.Listener`
+service thread; the rendezvous/endpoint directory is the same TCPStore the
+collective bootstrap uses (csrc/tcp_store.cc). Calls pickle (fn, args,
+kwargs), results come back pickled; `rpc_async` returns a
+`concurrent.futures.Future` ("FutureWrapper" in the reference).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+import traceback
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from multiprocessing.connection import Client, Listener
+from typing import Dict, Optional
+
+from .store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = -1
+
+_state: Dict[str, object] = {
+    "listener": None, "thread": None, "pool": None, "store": None,
+    "infos": {}, "self": None, "running": False,
+}
+_AUTHKEY = b"paddle_tpu_rpc"
+
+
+def _free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _host_ip(master_host):
+    """The address peers should dial for this worker. For a loopback
+    master everything is on one machine; otherwise use the interface that
+    routes toward the master (multi-host pods)."""
+    if master_host in ("127.0.0.1", "localhost", "::1"):
+        return "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect((master_host, 9))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _serve_conn(conn):
+    try:
+        while True:
+            try:
+                msg = conn.recv_bytes()
+            except (EOFError, OSError):
+                return
+            fn, args, kwargs = pickle.loads(msg)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # noqa: BLE001 — ship the error back
+                result = (False, "".join(traceback.format_exception(e)))
+            conn.send_bytes(pickle.dumps(result))
+    finally:
+        conn.close()
+
+
+def _serve(listener, pool):
+    while _state["running"]:
+        try:
+            conn = listener.accept()
+        except OSError:
+            return
+        pool.submit(_serve_conn, conn)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """reference: rpc.py:73. Starts the worker service, registers
+    (name, rank, ip, port) in the master TCPStore, and blocks until all
+    `world_size` workers registered."""
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29431")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    my_ip = _host_ip(host)
+    bind_addr = "127.0.0.1" if my_ip == "127.0.0.1" else "0.0.0.0"
+    listener = Listener((bind_addr, 0), authkey=_AUTHKEY)
+    my_port = listener.address[1]
+    pool = ThreadPoolExecutor(max_workers=8,
+                              thread_name_prefix="rpc_worker")
+    _state.update(listener=listener, pool=pool, running=True)
+    th = threading.Thread(target=_serve, args=(listener, pool), daemon=True)
+    th.start()
+    _state["thread"] = th
+
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    _state["store"] = store
+    if rank == 0:  # clear stale keys from a previous init on this endpoint
+        for r in range(world_size):
+            store.delete_key(f"rpc/{r}")
+        store.delete_key("rpc/shutdown")
+        store.delete_key("rpc/shutdown_ack")
+        store.set("rpc/ready", b"1")
+    else:
+        store.wait("rpc/ready")
+    me = WorkerInfo(name, rank, my_ip, my_port)
+    store.set(f"rpc/{rank}", pickle.dumps(tuple(me)))
+    infos = {}
+    for r in range(world_size):
+        info = WorkerInfo(*pickle.loads(store.wait(f"rpc/{r}")))
+        if info.name in {i.name for i in infos.values()}:
+            raise ValueError(f"worker name {info.name!r} is not unique")
+        infos[info.name] = info
+    _state["infos"] = infos
+    _state["self"] = me
+
+
+def _invoke(to, fn, args, kwargs):
+    info = _state["infos"].get(to)
+    if info is None:
+        raise RuntimeError(f"unknown rpc worker {to!r}; "
+                           f"known: {sorted(_state['infos'])}")
+    conn = Client((info.ip, info.port), authkey=_AUTHKEY)
+    try:
+        conn.send_bytes(pickle.dumps((fn, args or (), kwargs or {})))
+        ok, payload = pickle.loads(conn.recv_bytes())
+    finally:
+        conn.close()
+    if not ok:
+        raise RuntimeError(f"rpc to {to!r} failed remotely:\n{payload}")
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """reference: rpc.py:141 — blocking remote call."""
+    fut = rpc_async(to, fn, args, kwargs, timeout)
+    return fut.result(None if timeout in (None, -1) else timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """reference: rpc.py:179 — returns a Future with .wait()/.result()."""
+    pool: ThreadPoolExecutor = _state["pool"]
+    if pool is None:
+        raise RuntimeError("init_rpc must be called first")
+    fut: Future = pool.submit(_invoke, to, fn, args, kwargs)
+    fut.wait = fut.result  # paddle's FutureWrapper API
+    return fut
+
+
+def shutdown():
+    """reference: rpc.py:270 — barrier then stop serving."""
+    if not _state["running"]:
+        return
+    store = _state["store"]
+    world = len(_state["infos"])
+    me = _state["self"]
+    if store is not None and world:
+        import time
+        # phase 1: everyone arrives (no rank may stop serving before all
+        # peers are past their last rpc call)
+        n = store.add("rpc/shutdown", 1)
+        while n < world:
+            time.sleep(0.01)
+            n = store.add("rpc/shutdown", 0)
+        # phase 2: acks; the master (rank 0 hosts the store server) must
+        # outlive every client's final store op, so it leaves last
+        n = store.add("rpc/shutdown_ack", 1)
+        if me is not None and me.rank == 0:
+            while n < world:
+                time.sleep(0.01)
+                n = store.add("rpc/shutdown_ack", 0)
+    _state["running"] = False
+    try:
+        _state["listener"].close()
+    except Exception:
+        pass
+    if store is not None:
+        try:
+            store.close()
+        except AttributeError:
+            pass
+    _state["pool"].shutdown(wait=False)
+    _state.update(listener=None, thread=None, pool=None, store=None,
+                  infos={}, self=None)
+
+
+def get_worker_info(name) -> Optional[WorkerInfo]:
+    return _state["infos"].get(name)
+
+
+def get_all_worker_infos():
+    return sorted(_state["infos"].values(), key=lambda i: i.rank)
+
+
+def get_current_worker_info() -> Optional[WorkerInfo]:
+    return _state["self"]

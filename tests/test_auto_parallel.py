@@ -1,0 +1,230 @@
+"""auto_parallel Engine + recompute + rpc tests (8-device CPU mesh).
+
+Mirrors the reference's auto_parallel engine tests
+(unittests/auto_parallel/test_engine_api.py shape: build an MLP, Engine
+fit/evaluate/predict/save/load) and fleet recompute tests.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import auto_parallel as auto
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    dist.mesh._GLOBAL_MESH[0] = None
+    dist.mesh._GLOBAL_TOPO[0] = None
+
+
+class MLP(nn.Layer):
+    def __init__(self, d_in=8, d_h=16, d_out=4):
+        super().__init__()
+        self.fc1 = nn.Linear(d_in, d_h)
+        self.fc2 = nn.Linear(d_h, d_out)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _dataset(n=64, d_in=8, n_cls=4):
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(n, d_in)).astype(np.float32)
+    ys = rng.integers(0, n_cls, size=(n,)).astype(np.int64)
+    return [(xs[i], ys[i]) for i in range(n)]
+
+
+class TestPlacements:
+    def test_to_partition_spec(self):
+        mesh = auto.ProcessMesh(shape=[2, 4], dim_names=["x", "y"])
+        spec = auto.to_partition_spec(
+            [auto.Shard(0), auto.Replicate()], mesh)
+        assert spec == P("x")
+        spec = auto.to_partition_spec(
+            [auto.Shard(1), auto.Shard(0)], mesh, ndim=2)
+        assert spec == P("y", "x")
+
+    def test_placement_predicates(self):
+        assert auto.Shard(1).is_shard(1)
+        assert not auto.Shard(1).is_shard(0)
+        assert auto.Replicate().is_replicate()
+        assert auto.Partial().is_partial()
+
+
+class TestEngine:
+    def test_fit_evaluate_predict(self, tmp_path):
+        dist.init_mesh(dp=8)
+        model = MLP()
+        loss = nn.CrossEntropyLoss()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        engine = auto.Engine(model, loss, opt,
+                             metrics=paddle.metric.Accuracy())
+        history = engine.fit(_dataset(), batch_size=16, epochs=3,
+                             verbose=0)
+        assert len(history["loss"]) == 3
+        assert history["loss"][-1] < history["loss"][0]
+
+        res = engine.evaluate(_dataset(32), batch_size=16, verbose=0)
+        assert np.isfinite(res["loss"])
+
+        preds = engine.predict(_dataset(32), batch_size=16, verbose=0)
+        assert len(preds) == 2
+        assert preds[0][0].shape == (16, 4)
+
+        path = str(tmp_path / "ckpt")
+        engine.save(path)
+        w_before = np.asarray(model.fc1.weight.numpy())
+        engine.fit(_dataset(), batch_size=16, epochs=1, verbose=0)
+        engine.load(path)
+        np.testing.assert_allclose(np.asarray(model.fc1.weight.numpy()),
+                                   w_before, rtol=1e-6)
+
+    def test_engine_uses_compiled_step(self):
+        dist.init_mesh(dp=8)
+        model = MLP()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        engine = auto.Engine(model, nn.CrossEntropyLoss(), opt)
+        engine.fit(_dataset(32), batch_size=16, epochs=1, verbose=0)
+        assert engine._jit_train is not None
+        assert engine._acc_schema is not None
+
+    def test_strategy_fields(self):
+        s = auto.Strategy()
+        assert s.amp.dtype == "bfloat16"
+        assert s.recompute.enable is False
+        d = s.to_dict()
+        assert "sharding" in d and d["sharding"]["stage"] == 1
+
+
+class TestRecompute:
+    def test_grad_matches_plain(self):
+        model = MLP(8, 32, 4)
+        x = paddle.to_tensor(
+            np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32))
+
+        out = model(x)
+        loss = out.sum()
+        loss.backward()
+        ref = {n: np.asarray(p.grad.numpy())
+               for n, p in model.named_parameters()}
+        for p in model.parameters():
+            p.grad = None
+
+        h = dist.recompute(model.fc1, x)
+        h = nn.functional.relu(h)
+        out2 = dist.recompute(model.fc2, h)
+        loss2 = out2.sum()
+        np.testing.assert_allclose(float(loss.item()), float(loss2.item()),
+                                   rtol=1e-5)
+        loss2.backward()
+        for n, p in model.named_parameters():
+            assert p.grad is not None, f"no grad flowed to {n}"
+            np.testing.assert_allclose(np.asarray(p.grad.numpy()), ref[n],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_closure_function_params_get_grads(self):
+        """The paddle `create_custom_forward(block)` idiom: a plain
+        function closing over a layer must still route grads to it."""
+        block = MLP(8, 16, 4)
+
+        def create_custom_forward(module):
+            def custom_forward(*inputs):
+                return module(*inputs)
+            return custom_forward
+
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        out = dist.recompute(create_custom_forward(block), x)
+        out.sum().backward()
+        for n, p in block.named_parameters():
+            assert p.grad is not None, f"no grad flowed to {n}"
+
+    def test_recompute_sequential(self):
+        l1 = nn.Linear(8, 8)
+        l2 = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        out = dist.recompute_sequential({"segments": 2}, [l1, l2], x)
+        out.sum().backward()
+        assert l1.weight.grad is not None
+        assert l2.weight.grad is not None
+
+    def test_recompute_under_jit(self):
+        lin = nn.Linear(8, 8)
+
+        from paddle_tpu.core.tensor import Tensor
+
+        def step(warr, x):
+            lin.weight._set_array(warr)
+            out = dist.recompute(lin, Tensor(x))
+            loss = out.sum()
+            loss.backward()
+            g = lin.weight.grad._array
+            lin.weight.grad = None
+            return loss._array, g
+
+        xs = np.ones((2, 8), np.float32)
+        ref_l, ref_g = step(lin.weight._array, xs)
+        jit_l, jit_g = jax.jit(step)(lin.weight._array, xs)
+        np.testing.assert_allclose(np.asarray(ref_l), np.asarray(jit_l),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ref_g), np.asarray(jit_g),
+                                   rtol=1e-5)
+
+
+def _rpc_worker(rank, world, port, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from paddle_tpu.distributed import rpc
+
+    try:
+        rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
+                     master_endpoint=f"127.0.0.1:{port}")
+        if rank == 0:
+            out = rpc.rpc_sync("worker1", max, args=((3, 7),))
+            q.put(("result", out))
+            fut = rpc.rpc_async("worker1", len, args=("abcd",))
+            q.put(("async", fut.result(30)))
+        infos = rpc.get_all_worker_infos()
+        q.put(("infos", [i.name for i in infos]))
+        rpc.shutdown()
+        q.put(("done", rank))
+    except Exception as e:  # pragma: no cover
+        q.put(("error", f"{rank}: {e}"))
+
+
+class TestRPC:
+    def test_two_worker_rpc(self):
+        import multiprocessing as mp
+        import socket
+        ctx = mp.get_context("spawn")
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_rpc_worker, args=(r, 2, port, q))
+                 for r in range(2)]
+        for p in procs:
+            p.start()
+        msgs = {}
+        results = []
+        for _ in range(6):
+            kind, val = q.get(timeout=90)
+            assert kind != "error", val
+            results.append((kind, val))
+            msgs.setdefault(kind, []).append(val)
+        for p in procs:
+            p.join(30)
+        assert msgs["result"] == [7]
+        assert msgs["async"] == [4]
+        for names in msgs["infos"]:
+            assert names == ["worker0", "worker1"]
