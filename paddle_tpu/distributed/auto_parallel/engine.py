@@ -287,6 +287,8 @@ class Engine:
         self.prepare()
         loader = self._as_loader(valid_data, batch_size, False, num_workers,
                                  collate_fn)
+        for m in self._metrics:
+            m.reset()
         losses = []
         for step, batch in enumerate(loader):
             if steps is not None and step >= steps:

@@ -72,22 +72,28 @@ def _closure_params(function: Callable):
 
 
 def _recompute_impl(function: Callable, params, args, kwargs):
-    """Single implementation: lift (tensor args + params) into inputs of a
-    jax.checkpoint-wrapped pure function and route through the tape."""
-    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    """Single implementation: lift every Tensor in (args, kwargs) — however
+    deeply nested in containers — plus the closed-over params into inputs of
+    a jax.checkpoint-wrapped pure function and route through the tape."""
+    is_tensor = lambda x: isinstance(x, Tensor)  # noqa: E731
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                 is_leaf=is_tensor)
+    tensor_idx = [i for i, x in enumerate(leaves) if isinstance(x, Tensor)]
+    tensor_args = [leaves[i] for i in tensor_idx]
     n_args = len(tensor_args)
 
     def of_arrays(*arrays):
         arg_arrays, param_arrays = arrays[:n_args], arrays[n_args:]
-        it = iter(arg_arrays)
-        rebuilt = [Tensor(next(it)) if isinstance(a, Tensor) else a
-                   for a in args]
+        new_leaves = list(leaves)
+        for i, arr in zip(tensor_idx, arg_arrays):
+            new_leaves[i] = Tensor(arr)
+        r_args, r_kwargs = jax.tree_util.tree_unflatten(treedef, new_leaves)
         saved = [p._array for p in params]
         for p, arr in zip(params, param_arrays):
             p._array = arr
         try:
             with no_grad():
-                out = function(*rebuilt, **kwargs)
+                out = function(*r_args, **r_kwargs)
         finally:
             for p, arr in zip(params, saved):
                 p._array = arr
@@ -125,7 +131,7 @@ def recompute_sequential(ctx: dict, functions: Sequence[Callable], *args):
     segments = int(ctx.get("segments", 1))
     functions = list(functions)
     n = len(functions)
-    seg = max(1, n // max(1, segments))
+    seg = max(1, -(-n // max(1, segments)))  # ceil: at most `segments` chunks
 
     def make_chunk(fns):
         def chunk(*xs):

@@ -36,18 +36,10 @@ WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 _DEFAULT_RPC_TIMEOUT = -1
 
 _state: Dict[str, object] = {
-    "listener": None, "thread": None, "pool": None, "store": None,
-    "infos": {}, "self": None, "running": False,
+    "listener": None, "thread": None, "pool": None, "client_pool": None,
+    "store": None, "infos": {}, "self": None, "running": False,
 }
 _AUTHKEY = b"paddle_tpu_rpc"
-
-
-def _free_port():
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _host_ip(master_host):
@@ -110,13 +102,25 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     my_port = listener.address[1]
     pool = ThreadPoolExecutor(max_workers=8,
                               thread_name_prefix="rpc_worker")
-    _state.update(listener=listener, pool=pool, running=True)
+    # outgoing calls get their own pool: an inbound handler occupies a
+    # `pool` thread for its connection's lifetime, so sharing one pool lets
+    # inbound traffic starve (or, with nested RPC, deadlock) outgoing calls
+    client_pool = ThreadPoolExecutor(max_workers=8,
+                                     thread_name_prefix="rpc_client")
+    _state.update(listener=listener, pool=pool, client_pool=client_pool,
+                  running=True)
     th = threading.Thread(target=_serve, args=(listener, pool), daemon=True)
     th.start()
     _state["thread"] = th
 
     store = TCPStore(host, int(port), is_master=(rank == 0),
                      world_size=world_size)
+    if world_size > 1 and type(store._impl).__name__ == "_PyStore":
+        raise RuntimeError(
+            "init_rpc with world_size > 1 requires the native TCPStore "
+            "(csrc/tcp_store.cc): the pure-python fallback store is "
+            "per-process, so cross-process rendezvous would hang. "
+            "Build it with `make -C csrc`.")
     _state["store"] = store
     if rank == 0:  # clear stale keys from a previous init on this endpoint
         for r in range(world_size):
@@ -162,7 +166,7 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
     """reference: rpc.py:179 — returns a Future with .wait()/.result()."""
-    pool: ThreadPoolExecutor = _state["pool"]
+    pool: ThreadPoolExecutor = _state["client_pool"]
     if pool is None:
         raise RuntimeError("init_rpc must be called first")
     fut: Future = pool.submit(_invoke, to, fn, args, kwargs)
@@ -203,8 +207,9 @@ def shutdown():
         except AttributeError:
             pass
     _state["pool"].shutdown(wait=False)
-    _state.update(listener=None, thread=None, pool=None, store=None,
-                  infos={}, self=None)
+    _state["client_pool"].shutdown(wait=False)
+    _state.update(listener=None, thread=None, pool=None, client_pool=None,
+                  store=None, infos={}, self=None)
 
 
 def get_worker_info(name) -> Optional[WorkerInfo]:

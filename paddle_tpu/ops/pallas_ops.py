@@ -6,16 +6,19 @@ On TPU the equivalents are Pallas kernels; each has a jnp fallback (used on
 CPU meshes, in tests, and whenever shapes don't meet the MXU tiling
 constraints), so the op surface is identical everywhere.
 
-Currently: flash (causal) attention forward with online softmax. Backward
-uses the recompute formulation in jnp under jax.custom_vjp — per-layer
-remat bounds its memory, and XLA fuses the recomputed pieces.
+Flash (causal) attention: forward with online softmax emitting the
+per-row logsumexp, and a true flash backward (dq kernel + dk/dv kernel)
+that recomputes attention probabilities block-wise from the saved LSE —
+no O(S^2) materialization in either direction.
+
+Set ``_INTERPRET = True`` (tests do) to run the kernels through the Pallas
+interpreter on CPU for numerical validation without TPU hardware.
 """
 from __future__ import annotations
 
 import functools
 import math
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -24,6 +27,9 @@ __all__ = ["causal_attention", "flash_attention_available"]
 
 _BQ = 256
 _BK = 256
+
+# Flip to True to force the Pallas path through the interpreter (CPU tests).
+_INTERPRET = False
 
 
 def _on_tpu():
@@ -35,8 +41,8 @@ def _on_tpu():
 
 def flash_attention_available(q_shape):
     B, S, H, D = q_shape
-    return (_on_tpu() and D % 128 == 0 and S % _BQ == 0 and S % _BK == 0
-            and S >= _BQ)
+    shapes_ok = D % 128 == 0 and S % _BQ == 0 and S % _BK == 0 and S >= _BQ
+    return shapes_ok and (_on_tpu() or _INTERPRET)
 
 
 # ---------------------------------------------------------------------------
@@ -58,10 +64,10 @@ def _attention_jnp(q, k, v):
 
 
 # ---------------------------------------------------------------------------
-# Pallas flash forward
+# Pallas flash forward (emits LSE for the backward)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, scale):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # [bq, D]
@@ -74,15 +80,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, scale):
         m, l, acc = carry
         k = k_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
         k_pos = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot(
+        acc_new = acc * corr[:, None] + lax.dot(
             p, v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -91,30 +97,165 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, scale):
     acc0 = jnp.zeros((bq, D), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
 
 
 def _flash_fwd(q, k, v):
+    """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S] fp32)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    B, S, H, D = q.shape
+    BH, S, D = q.shape
     scale = 1.0 / math.sqrt(D)
-    # layout: [B*H, S, D]
-    def to_bh(x):
-        return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    grid = (B * H, S // _BQ)
-    out = pl.pallas_call(
+    grid = (BH, S // _BQ)
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, bq=_BQ, bk=_BK, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, S), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=(pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, _BQ), lambda b, i: (b, i))),
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash backward: dq kernel (loops over k blocks)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, bq, bk, scale):
+    from jax.experimental import pallas as pl
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # [bq, D]
+    g = g_ref[0].astype(jnp.float32)            # [bq, D]
+    lse = lse_ref[0]                            # [bq]
+    delta = delta_ref[0]                        # [bq]
+    D = q.shape[-1]
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    n_kblocks = (qi * bq + bq + bk - 1) // bk
+
+    def body(i, dq):
+        k = k_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        k_pos = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        dp = lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, n_kblocks, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash backward: dk/dv kernel (loops over q blocks)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, bq, bk, scale, n_qblocks):
+    from jax.experimental import pallas as pl
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)            # [bk, D]
+    v = v_ref[0].astype(jnp.float32)            # [bk, D]
+    D = k.shape[-1]
+    k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+
+    first_q = (ki * bk) // bq  # causal: earlier q blocks are fully masked
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        dv_new = dv + lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, D), jnp.float32)
+    dv0 = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = lax.fori_loop(first_q, n_qblocks, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, g, o, lse):
+    """All inputs [BH, S, D] (lse [BH, S]); returns dq, dk, dv."""
+    from jax.experimental import pallas as pl
+    BH, S, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    full = lambda b, i: (b, 0, 0)  # noqa: E731
+    full1 = lambda b, i: (b, 0)    # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, bq=_BQ, bk=_BK, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        grid=(BH, S // _BQ),
+        in_specs=[
+            pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, _BQ), lambda b, i: (b, i)),
+            pl.BlockSpec((1, _BQ), lambda b, i: (b, i)),
+        ],
         out_specs=pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
-    )(qb, kb, vb)
-    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, bq=_BQ, bk=_BK, scale=scale,
+                          n_qblocks=S // _BQ),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)),
+        grid=(BH, S // _BK),
+        in_specs=[
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), full),
+            pl.BlockSpec((1, S), full1),
+            pl.BlockSpec((1, S), full1),
+        ],
+        out_specs=(pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0))),
+        interpret=_INTERPRET,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+def _to_bh(x):
+    B, S, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+
+def _from_bh(x, B, H):
+    BH, S, D = x.shape
+    return jnp.swapaxes(x.reshape(B, H, S, D), 1, 2)
 
 
 @jax.custom_vjp
@@ -122,15 +263,27 @@ def causal_attention(q, k, v):
     """Causal self-attention, [B, S, H, D] layout. Pallas flash kernel on
     TPU for qualifying shapes; XLA-fused jnp otherwise."""
     if flash_attention_available(q.shape):
-        return _flash_fwd(q, k, v)
+        out, _ = _flash_fwd(_to_bh(q), _to_bh(k), _to_bh(v))
+        return _from_bh(out, q.shape[0], q.shape[2])
     return _attention_jnp(q, k, v)
 
 
 def _fwd(q, k, v):
-    return causal_attention(q, k, v), (q, k, v)
+    if flash_attention_available(q.shape):
+        B, H = q.shape[0], q.shape[2]
+        qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+        out, lse = _flash_fwd(qb, kb, vb)
+        return _from_bh(out, B, H), (qb, kb, vb, out, lse)
+    return _attention_jnp(q, k, v), (q, k, v)
 
 
 def _bwd(res, g):
+    if len(res) == 5:
+        qb, kb, vb, out, lse = res
+        B, H = g.shape[0], g.shape[2]
+        gb = _to_bh(g)
+        dq, dk, dv = _flash_bwd(qb, kb, vb, gb, out, lse)
+        return (_from_bh(dq, B, H), _from_bh(dk, B, H), _from_bh(dv, B, H))
     q, k, v = res
     # recompute-based backward via jax.vjp of the jnp reference
     _, vjp_fn = jax.vjp(_attention_jnp, q, k, v)
