@@ -96,6 +96,14 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         "PADDLE_MASTER", "127.0.0.1:29431")
     host, port = master_endpoint.rsplit(":", 1)
 
+    from ..core import native
+    if world_size > 1 and not native.available():
+        raise RuntimeError(
+            "init_rpc with world_size > 1 requires the native TCPStore "
+            "(csrc/tcp_store.cc): the pure-python fallback store is "
+            "per-process, so cross-process rendezvous would hang. "
+            "Build it with `make -C csrc`.")
+
     my_ip = _host_ip(host)
     bind_addr = "127.0.0.1" if my_ip == "127.0.0.1" else "0.0.0.0"
     listener = Listener((bind_addr, 0), authkey=_AUTHKEY)
@@ -115,12 +123,6 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
     store = TCPStore(host, int(port), is_master=(rank == 0),
                      world_size=world_size)
-    if world_size > 1 and type(store._impl).__name__ == "_PyStore":
-        raise RuntimeError(
-            "init_rpc with world_size > 1 requires the native TCPStore "
-            "(csrc/tcp_store.cc): the pure-python fallback store is "
-            "per-process, so cross-process rendezvous would hang. "
-            "Build it with `make -C csrc`.")
     _state["store"] = store
     if rank == 0:  # clear stale keys from a previous init on this endpoint
         for r in range(world_size):

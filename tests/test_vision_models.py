@@ -1,0 +1,70 @@
+"""Model-zoo forward smoke + shape tests.
+
+Mirrors the reference's python/paddle/tests/test_vision_models.py: build
+each architecture, run a forward pass on a small input, check the logits
+shape. Uses 96x96 inputs (enough for every stride pyramid incl.
+InceptionV3's stem at 299-style reductions) and 10 classes to stay fast
+on CPU."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _check(model, size=96, num_classes=10, batch=2):
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal(
+            (batch, 3, size, size), dtype=np.float32))
+    model.eval()
+    out = model(x)
+    if isinstance(out, (tuple, list)):  # googlenet aux heads
+        out = out[0]
+    assert tuple(out.shape) == (batch, num_classes)
+
+
+@pytest.mark.parametrize("ctor", [
+    models.alexnet,
+    models.vgg11,
+    models.squeezenet1_0,
+    models.squeezenet1_1,
+    models.mobilenet_v1,
+    models.mobilenet_v2,
+    models.mobilenet_v3_small,
+    models.mobilenet_v3_large,
+    models.shufflenet_v2_x0_25,
+    models.shufflenet_v2_swish,
+    models.densenet121,
+    models.googlenet,
+    models.resnet18,
+    models.resnext50_32x4d,
+], ids=lambda c: c.__name__)
+def test_model_forward(ctor):
+    _check(ctor(num_classes=10))
+
+
+def test_inception_v3_forward():
+    _check(models.inception_v3(num_classes=10), size=128)
+
+
+def test_vgg_batch_norm_variant():
+    _check(models.vgg11(batch_norm=True, num_classes=10))
+
+
+def test_model_without_head():
+    m = models.mobilenet_v2(num_classes=0, with_pool=True)
+    x = paddle.to_tensor(np.zeros((1, 3, 96, 96), np.float32))
+    m.eval()
+    out = m(x)
+    assert tuple(out.shape)[:2] == (1, 1280)
+
+
+def test_state_dict_roundtrip():
+    m = models.mobilenet_v3_small(num_classes=10)
+    sd = m.state_dict()
+    m2 = models.mobilenet_v3_small(num_classes=10)
+    m2.set_state_dict(sd)
+    x = paddle.to_tensor(np.ones((1, 3, 96, 96), np.float32))
+    m.eval(), m2.eval()
+    np.testing.assert_allclose(np.asarray(m(x).numpy()),
+                               np.asarray(m2(x).numpy()), rtol=1e-6)
