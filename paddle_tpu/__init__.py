@@ -54,6 +54,9 @@ from . import static  # noqa: E402
 from . import distribution  # noqa: E402
 from . import geometric  # noqa: E402
 from . import utils  # noqa: E402
+from . import quantization  # noqa: E402
+from . import text  # noqa: E402
+from . import audio  # noqa: E402
 
 from .framework.io import save, load  # noqa: E402
 from .autograd.functional import grad  # noqa: E402

@@ -1,0 +1,11 @@
+"""paddle.audio parity (reference: python/paddle/audio/__init__.py).
+
+functional (mel/fft frequency math, filterbanks, windows), features
+(Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers), and wav-file
+backends (stdlib `wave`-based load/save/info — the reference shells out to
+soundfile, unavailable here)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "load", "info", "save"]

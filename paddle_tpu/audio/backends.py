@@ -1,0 +1,67 @@
+"""Wave-file IO (reference: python/paddle/audio/backends/backend.py
+load/save/info over soundfile).
+
+Implemented on the stdlib `wave` module (16-bit PCM) so the API works in
+hermetic environments; returns numpy arrays shaped [channels, frames]
+like the reference with `channels_first=True`."""
+from __future__ import annotations
+
+import wave
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save"]
+
+AudioInfo = namedtuple(
+    "AudioInfo",
+    ["sample_rate", "num_samples", "num_channels", "bits_per_sample",
+     "encoding"])
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(),
+                         w.getnchannels(), w.getsampwidth() * 8,
+                         "PCM_S")
+
+
+def load(filepath: str, frame_offset=0, num_frames=-1,
+         normalize=True, channels_first=True):
+    """Returns (data, sample_rate); data float32 in [-1, 1] when
+    `normalize` else int16."""
+    with wave.open(filepath, "rb") as w:
+        if w.getsampwidth() != 2:
+            raise ValueError(
+                f"only 16-bit PCM wav is supported, got "
+                f"{w.getsampwidth() * 8}-bit: {filepath!r}")
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        total = w.getnframes()
+        frame_offset = min(frame_offset, total)
+        w.setpos(frame_offset)
+        remaining = total - frame_offset
+        n = remaining if num_frames < 0 else min(num_frames, remaining)
+        raw = w.readframes(n)
+    data = np.frombuffer(raw, dtype=np.int16).reshape(-1, nch)
+    if normalize:
+        data = (data.astype(np.float32) / 32768.0)
+    if channels_first:
+        data = data.T
+    return data, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first=True,
+         bits_per_sample=16):
+    assert bits_per_sample == 16, "only 16-bit PCM supported"
+    data = np.asarray(src)
+    if channels_first:
+        data = data.T  # -> [frames, channels]
+    if data.dtype != np.int16:
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(data.tobytes())

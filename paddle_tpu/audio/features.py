@@ -1,0 +1,101 @@
+"""Audio feature layers (reference:
+python/paddle/audio/features/layers.py — Spectrogram:25,
+MelSpectrogram:107, LogMelSpectrogram:207, MFCC:310)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn, signal
+from ..core.tensor import Tensor, apply_op
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        assert power > 0, "power must be positive"
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = Tensor(
+            F.get_window(window, self.win_length, fftbins=True,
+                         dtype=dtype))
+
+    def forward(self, x):
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self.fft_window, center=self.center,
+                           pad_mode=self.pad_mode)
+        power = self.power
+        return apply_op(
+            lambda s: jnp.abs(s) ** power, spec, op_name="spec_power")
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = Tensor(F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., freq, frames]
+        return apply_op(
+            lambda fb, s: jnp.einsum("mf,...ft->...mt", fb, s),
+            self.fbank_matrix, spec, op_name="mel_project")
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return apply_op(
+            lambda m: F.power_to_db(m, self.ref_value, self.amin,
+                                    self.top_db),
+            mel, op_name="power_to_db")
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = Tensor(F.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+        return apply_op(
+            lambda d, m: jnp.einsum("mk,...mt->...kt", d, m),
+            self.dct_matrix, logmel, op_name="dct_project")

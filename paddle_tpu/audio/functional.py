@@ -1,0 +1,115 @@
+"""paddle.audio.functional parity (reference:
+python/paddle/audio/functional/functional.py).
+
+Pure jnp implementations — filterbank construction is host-side-cacheable
+constant math; the per-batch transforms (stft/mel projection) are dense
+matmuls that XLA maps onto the MXU."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .window import get_window  # noqa: F401  (re-exported)
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """reference: functional.py:22."""
+    scalar = not hasattr(freq, "ndim")
+    f = jnp.asarray(freq, jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+        return float(mel) if scalar else mel
+    f_min, f_sp = 0.0, 200.0 / 3
+    mel = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    mel = jnp.where(f >= min_log_hz,
+                    min_log_mel + jnp.log(f / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    """reference: functional.py:78."""
+    scalar = not hasattr(mel, "ndim")
+    m = jnp.asarray(mel, jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return float(hz) if scalar else hz
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    freqs = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return float(freqs) if scalar else freqs
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """reference: functional.py:123."""
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return mel_to_hz(mels, htk).astype(dtype)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """reference: functional.py:163."""
+    return jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2).astype(dtype)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (reference: functional.py:186)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights.astype(dtype)
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference: functional.py:259."""
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+    x = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc] (reference: functional.py:303)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        assert norm == "ortho"
+        dct = dct * jnp.where(k == 0, math.sqrt(1.0 / n_mels),
+                              math.sqrt(2.0 / n_mels))
+    return dct.astype(dtype)

@@ -1,0 +1,95 @@
+"""Window functions (reference: python/paddle/audio/functional/window.py
+`get_window`)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["get_window"]
+
+
+def _extend(needs_trunc, win_length):
+    return (win_length + 1, True) if needs_trunc else (win_length, False)
+
+
+def _truncate(w, needs_trunc):
+    return w[:-1] if needs_trunc else w
+
+
+def _cosine_sum(coeffs, M, sym):
+    M_ext, trunc = _extend(not sym, M)
+    n = jnp.arange(M_ext, dtype=jnp.float32)
+    w = jnp.zeros(M_ext, jnp.float32)
+    for i, a in enumerate(coeffs):
+        w = w + a * jnp.cos(2 * math.pi * i * n / (M_ext - 1))
+    return _truncate(w, trunc)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """'hann'|'hamming'|'blackman'|'bartlett'|'bohman'|'cosine'|
+    ('gaussian', std)|('exponential', center, tau)|('kaiser', beta)|
+    ('tukey', alpha) — reference window.py:get_window."""
+    sym = not fftbins
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+
+    if name == "hann":
+        w = _cosine_sum([0.5, -0.5], win_length, sym)
+    elif name == "hamming":
+        w = _cosine_sum([0.54, -0.46], win_length, sym)
+    elif name == "blackman":
+        w = _cosine_sum([0.42, -0.5, 0.08], win_length, sym)
+    elif name == "bartlett":
+        M, trunc = _extend(not sym, win_length)
+        n = jnp.arange(M, dtype=jnp.float32)
+        w = _truncate(1.0 - jnp.abs(2 * n / (M - 1) - 1.0), trunc)
+    elif name == "bohman":
+        M, trunc = _extend(not sym, win_length)
+        x = jnp.abs(jnp.linspace(-1, 1, M))
+        w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+        w = _truncate(w.at[0].set(0.0).at[-1].set(0.0), trunc)
+    elif name == "cosine":
+        M, trunc = _extend(not sym, win_length)
+        n = jnp.arange(M, dtype=jnp.float32)
+        w = _truncate(jnp.sin(math.pi / M * (n + 0.5)), trunc)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        M, trunc = _extend(not sym, win_length)
+        n = jnp.arange(M, dtype=jnp.float32) - (M - 1) / 2
+        w = _truncate(jnp.exp(-0.5 * (n / std) ** 2), trunc)
+    elif name == "exponential":
+        center = args[0] if len(args) > 0 and args[0] is not None else None
+        tau = args[1] if len(args) > 1 else 1.0
+        M, trunc = _extend(not sym, win_length)
+        if center is None:
+            center = (M - 1) / 2
+        n = jnp.arange(M, dtype=jnp.float32)
+        w = _truncate(jnp.exp(-jnp.abs(n - center) / tau), trunc)
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        M, trunc = _extend(not sym, win_length)
+        n = jnp.arange(M, dtype=jnp.float32)
+        alpha = (M - 1) / 2.0
+        import jax.scipy.special as jss  # i0 via jax
+        w = _truncate(jss.i0(beta * jnp.sqrt(
+            jnp.clip(1 - ((n - alpha) / alpha) ** 2, 0, 1))) / jss.i0(
+                jnp.asarray(beta)), trunc)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        M, trunc = _extend(not sym, win_length)
+        n = jnp.arange(M, dtype=jnp.float32)
+        width = alpha * (M - 1) / 2.0
+        w = jnp.ones(M, jnp.float32)
+        left = n < width
+        right = n > (M - 1) - width
+        w = jnp.where(left, 0.5 * (1 + jnp.cos(
+            math.pi * (n / width - 1))), w)
+        w = jnp.where(right, 0.5 * (1 + jnp.cos(
+            math.pi * ((n - (M - 1)) / width + 1))), w)
+        w = _truncate(w, trunc)
+    else:
+        raise ValueError(f"unsupported window: {window!r}")
+    return w.astype(dtype)

@@ -1,3 +1,4 @@
-"""paddle.incubate parity: fused nn ops, autograd extras, MoE."""
+"""paddle.incubate parity: fused nn ops, autograd extras, MoE, ASP."""
 from . import nn
 from . import autograd
+from . import asp

@@ -1,0 +1,104 @@
+"""ASP workflow: prune, train with mask maintenance, check.
+
+Reference: python/paddle/incubate/asp/asp.py (prune_model:302,
+decorate:216, set_excluded_layers:40, ASPHelper:515,
+OptimizerWithSparsityGuarantee:918).
+
+TPU note: there is no sparse-tensor-core analog on the MXU, so 2:4
+sparsity here serves the model-compression workflow (masks kept exact
+through training; the zeros compress checkpoints and can feed
+sparsity-aware serving) rather than a kernel speedup. Mask re-application
+after each optimizer step is an elementwise multiply XLA fuses away.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from . import utils
+
+__all__ = ["prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers", "ASPHelper"]
+
+
+class ASPHelper:
+    """reference: asp.py:515 — tracks exclusions; masks live on the
+    pruned parameters themselves (`param._asp_mask`), so their lifetime
+    is the parameter's and no global registry can go stale."""
+
+    MASK_APPENDDED_NAME = "_asp_mask"
+    _excluded_layers: list = []
+
+    @classmethod
+    def is_supported_layer(cls, param_name: str, param) -> bool:
+        if param.ndim < 2:
+            return False  # biases / norms
+        for ex in cls._excluded_layers:
+            if ex and ex in param_name:
+                return False
+        return True
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo=utils.MaskAlgo.MASK_1D,
+                    with_mask=True):
+        masks = {}
+        for name, param in model.named_parameters():
+            if not cls.is_supported_layer(name, param):
+                continue
+            mask = utils.create_mask(np.asarray(param._array),
+                                     func_name=mask_algo, n=n, m=m)
+            mask_arr = jnp.asarray(mask, param._array.dtype)
+            param._array = param._array * mask_arr
+            if with_mask:
+                setattr(param, cls.MASK_APPENDDED_NAME, mask_arr)
+            masks[name] = mask_arr
+        return masks
+
+    @classmethod
+    def reapply_masks(cls, parameters):
+        for p in parameters:
+            mask = getattr(p, cls.MASK_APPENDDED_NAME, None)
+            if mask is not None:
+                p._array = p._array * mask
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """reference: asp.py:40 — names (substrings) to skip when pruning."""
+    ASPHelper._excluded_layers = list(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    """reference: asp.py:127."""
+    ASPHelper._excluded_layers = []
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """reference: asp.py:302 — compute + apply n:m masks over every
+    supported parameter; returns {param_name: mask}."""
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo,
+                                 with_mask=with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference: asp.py:918 — re-applies masks after every step so
+    pruned weights stay exactly zero through training."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        params = getattr(self._optimizer, "_parameter_list", None) or []
+        ASPHelper.reapply_masks(params)
+
+    def clear_grad(self, *a, **k):
+        return self._optimizer.clear_grad(*a, **k)
+
+
+def decorate(optimizer):
+    """reference: asp.py:216."""
+    return OptimizerWithSparsityGuarantee(optimizer)

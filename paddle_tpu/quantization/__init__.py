@@ -1,0 +1,23 @@
+"""paddle.quantization parity — QAT/PTQ over pure XLA-fused fake-quant.
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT, quanters) and
+python/paddle/quantization/imperative (ImperativePTQ)."""
+from .functional import (  # noqa: F401
+    fake_quant_dequant, quant_tensor, dequant_tensor)
+from .quanters import (  # noqa: F401
+    BaseQuanter, quanter, QuanterFactory, FakeQuanterWithAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserverLayer, AbsmaxObserver,
+    MovingAverageAbsmaxObserver)
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .qat import (  # noqa: F401
+    QAT, PTQ, QuantedWrapper, ObserveWrapper, quant_aware, convert)
+
+__all__ = [
+    "fake_quant_dequant", "quant_tensor", "dequant_tensor",
+    "BaseQuanter", "quanter", "QuanterFactory",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterWithAbsMaxObserverLayer",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "QuantConfig", "SingleLayerConfig",
+    "QAT", "PTQ", "QuantedWrapper", "ObserveWrapper", "quant_aware",
+    "convert",
+]

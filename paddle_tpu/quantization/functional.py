@@ -1,0 +1,64 @@
+"""Fake-quantization primitives with straight-through gradients.
+
+Reference analog: the fake_quantize_* / fake_channel_wise_quantize ops
+(paddle/fluid/operators/fake_quantize_op.cc) that back
+FakeQuanterWithAbsMaxObserverLayer (python/paddle/quantization/quanters/
+abs_max.py:94).
+
+TPU-native design: fake quant-dequant is a pure elementwise function —
+XLA fuses it into the surrounding matmul/conv, so a QAT step costs almost
+nothing extra on the MXU. The straight-through estimator is a
+jax.custom_vjp that passes gradients inside the clipping range and zeros
+them outside (the saturating-STE formulation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fake_quant_dequant", "quant_tensor", "dequant_tensor"]
+
+
+@jax.custom_vjp
+def _fqd(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fqd_fwd(x, scale, qmax):
+    return _fqd(x, scale, qmax), (x, scale)
+
+
+def _fqd_bwd(res, g):
+    x, scale = res
+    inside = (jnp.abs(x) <= jnp.maximum(scale, 1e-9)).astype(g.dtype)
+    return g * inside, None, None
+
+
+_fqd.defvjp(_fqd_fwd, _fqd_bwd)
+
+
+def fake_quant_dequant(x, scale, bits=8, quant_axis=None):
+    """Quantize-dequantize `x` symmetrically to `bits` with saturating STE.
+
+    `scale` is the absmax (per-tensor scalar, or per-channel along
+    `quant_axis` with broadcast-ready shape)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if quant_axis is not None and jnp.ndim(scale) > 0:
+        shape = [1] * x.ndim
+        shape[quant_axis] = -1
+        scale = jnp.reshape(scale, shape)
+    return _fqd(x, scale, qmax)
+
+
+def quant_tensor(x, scale, bits=8):
+    """True quantization to int (for export); no gradient."""
+    qmax = 2 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax).astype(jnp.int8)
+
+
+def dequant_tensor(q, scale, bits=8, dtype=jnp.float32):
+    qmax = 2 ** (bits - 1) - 1
+    return q.astype(dtype) * (scale / qmax)

@@ -1,0 +1,178 @@
+"""QAT / PTQ model transforms and quanted-layer wrappers.
+
+Reference analog: python/paddle/quantization/qat.py:22 (QAT.quantize),
+wrapper.py:20 (ObserveWrapper), imperative/ptq.py (ImperativePTQ).
+
+TPU-native design: "quantize" is a pure model-to-model transform that
+wraps matmul/conv layers with fake-quant layers; the fake-quant math is
+elementwise and fuses into the XLA graph, so QAT trains at nearly full
+speed on the MXU. `convert` freezes observers and bakes weight scales for
+int8 export via jit.save's StableHLO path."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .quanters import QuanterFactory
+
+__all__ = ["QAT", "PTQ", "QuantedWrapper", "ObserveWrapper",
+           "quant_aware", "convert"]
+
+
+class QuantedWrapper(Layer):
+    """Wraps a Linear/Conv layer: fake-quants the activation and the
+    weight, then runs the original layer with the quantized weight (the
+    reference's QuantedLinear/QuantedConv2D in nn/quant/quant_layers.py)."""
+
+    def __init__(self, layer: Layer, activation_quanter=None,
+                 weight_quanter=None):
+        super().__init__()
+        self._layer = layer
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x, *args, **kwargs):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._layer,
+                                                       "weight"):
+            w = self._layer.weight
+            qw = self.weight_quanter(w)
+            orig = w._array
+            w._array = qw._array
+            try:
+                return self._layer(x, *args, **kwargs)
+            finally:
+                w._array = orig
+        return self._layer(x, *args, **kwargs)
+
+
+class ObserveWrapper(Layer):
+    """reference: wrapper.py:20 — observe-only wrapper used by PTQ."""
+
+    def __init__(self, observer, observed: Layer, observe_input=True):
+        super().__init__()
+        self._observer = observer
+        self._observed = observed
+        self._observe_input = observe_input
+
+    def forward(self, x, *args, **kwargs):
+        if self._observe_input:
+            x = self._observer(x)
+            return self._observed(x, *args, **kwargs)
+        out = self._observed(x, *args, **kwargs)
+        return self._observer(out)
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    if isinstance(factory, QuanterFactory):
+        return factory._instance()
+    return factory() if isinstance(factory, type) else factory
+
+
+def _transform(model: Layer, config: QuantConfig, wrapper_cls,
+               full_name=""):
+    for name, sub in list(model._sub_layers.items()):
+        child_name = f"{full_name}.{name}" if full_name else name
+        mapped = config.qat_layer_mappings.get(type(sub))
+        if mapped is not None:
+            model._sub_layers[name] = mapped(sub)
+            continue
+        if config._is_quantifiable(sub):
+            cfg = config._get_config_by_layer(sub, child_name)
+            if cfg is not None and (cfg.activation is not None
+                                    or cfg.weight is not None):
+                model._sub_layers[name] = wrapper_cls(
+                    sub, _make(cfg.activation), _make(cfg.weight))
+                continue
+        _transform(sub, config, wrapper_cls, child_name)
+    return model
+
+
+class QAT:
+    """reference: qat.py:22."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        assert model.training, \
+            "QAT.quantize expects a train-mode model (call model.train())"
+        if not inplace:
+            model = copy.deepcopy(model)
+        return _transform(model, self._config, QuantedWrapper)
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        return convert(model, inplace=inplace)
+
+
+class PTQ:
+    """Post-training quantization: insert observers, run calibration data
+    through the model, then convert (reference: imperative/ptq.py)."""
+
+    def __init__(self, config: QuantConfig = None):
+        if config is None:
+            from .quanters import QuanterFactory, AbsmaxObserver
+            config = QuantConfig(
+                activation=QuanterFactory(AbsmaxObserver),
+                weight=QuanterFactory(AbsmaxObserver))
+        self._config = config
+
+    def quantize(self, model: Layer, inplace=False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        return _transform(model, self._config, QuantedWrapper)
+
+    def convert(self, model: Layer, inplace=False) -> Layer:
+        return convert(model, inplace=inplace)
+
+
+def convert(model: Layer, inplace=False) -> Layer:
+    """Freeze quanters: replace each QuantedWrapper by its inner layer with
+    the weight fake-quantized in place (so the exported StableHLO carries
+    the quantization error) and record scales as buffers for int8 export."""
+    from ..core.tensor import Tensor
+    if not inplace:
+        model = copy.deepcopy(model)
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, QuantedWrapper):
+            inner = sub._layer
+            wq = sub.weight_quanter
+            if wq is not None and hasattr(inner, "weight"):
+                # bake quantization error directly from the observed scale
+                # (observer-type quanters have identity forwards, so calling
+                # wq(weight) would be a no-op for PTQ)
+                from .functional import fake_quant_dequant
+                inner.weight._array = fake_quant_dequant(
+                    inner.weight._array, wq.scales()._array,
+                    bits=wq.bit_length, quant_axis=wq.quant_axis)
+                try:
+                    inner.register_buffer("weight_scale",
+                                          Tensor(wq.scales()._array))
+                except Exception:
+                    pass
+            aq = sub.activation_quanter
+            if aq is not None:
+                try:
+                    inner.register_buffer("activation_scale",
+                                          Tensor(aq.scales()._array))
+                except Exception:
+                    pass
+            model._sub_layers[name] = inner
+        else:
+            convert(sub, inplace=True)
+    return model
+
+
+def quant_aware(model: Layer, config: QuantConfig = None,
+                inplace=False) -> Layer:
+    """Convenience one-call QAT entry (the paddleslim-style API)."""
+    if config is None:
+        from .quanters import FakeQuanterWithAbsMaxObserver
+        q = FakeQuanterWithAbsMaxObserver()
+        config = QuantConfig(activation=q, weight=q)
+    return QAT(config).quantize(model, inplace=inplace)

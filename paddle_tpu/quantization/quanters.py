@@ -1,0 +1,162 @@
+"""Quanters and observers.
+
+Reference analog: python/paddle/quantization/base_quanter.py:25
+(BaseQuanter), quanters/abs_max.py:25/:94 (FakeQuanterWithAbsMaxObserver
+factory + layer), imperative/ptq_quantizer.py (the PTQ observer family).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer.layers import Layer
+from .functional import fake_quant_dequant
+
+__all__ = ["BaseQuanter", "quanter", "QuanterFactory",
+           "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer",
+           "AbsmaxObserver", "MovingAverageAbsmaxObserver"]
+
+
+class BaseQuanter(Layer):
+    """reference: base_quanter.py:25 — abstract fake-quant layer exposing
+    scales/zero_points/bit_length/quant_axis for export."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):  # symmetric schemes: always zero
+        return None
+
+    @property
+    def bit_length(self):
+        return getattr(self, "_bits", 8)
+
+    @property
+    def quant_axis(self):
+        return getattr(self, "_quant_axis", None)
+
+
+class QuanterFactory:
+    """reference: factory.py:52 — holds (cls, args) and instantiates per
+    wrapped layer; lets QuantConfig carry configured-but-unbuilt quanters."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+
+def quanter(class_name):
+    """reference: factory.py:73 — decorator declaring a factory alias for a
+    quanter layer class; the factory lands in this module's namespace."""
+    def wrap(cls):
+        def make(*args, **kwargs):
+            return QuanterFactory(cls, *args, **kwargs)
+        make.__name__ = class_name
+        globals()[class_name] = make
+        return cls
+    return wrap
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """Moving-average absmax fake quanter
+    (reference: quanters/abs_max.py:94)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8,
+                 quant_axis=None, dtype="float32", name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bits = bit_length
+        self._quant_axis = quant_axis
+        self.register_buffer("_scale", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("_state", Tensor(jnp.ones([], jnp.float32)))
+        self.register_buffer("_accum", Tensor(jnp.ones([], jnp.float32)))
+
+    def _absmax(self, arr):
+        if self._quant_axis is None:
+            return jnp.max(jnp.abs(arr)).astype(jnp.float32)
+        axes = tuple(i for i in range(arr.ndim) if i != self._quant_axis)
+        return jnp.max(jnp.abs(arr), axis=axes).astype(jnp.float32)
+
+    def forward(self, x):
+        if self.training:
+            absmax = self._absmax(x._array)
+            if self._scale._array.shape != absmax.shape:
+                # first per-channel observation: grow the scalar buffers
+                self._state._array = jnp.ones_like(absmax)
+                self._accum._array = jnp.ones_like(absmax)
+            r = self._moving_rate
+            state = self._state._array * r + 1.0
+            accum = self._accum._array * r + absmax
+            self._state._array = state
+            self._accum._array = accum
+            self._scale._array = accum / state
+        return apply_op(fake_quant_dequant, x, self._scale._array,
+                        op_name="fake_quant", bits=self._bits,
+                        quant_axis=self._quant_axis)
+
+    def scales(self):
+        return Tensor(self._scale._array)
+
+
+# the reference's public factory name
+@quanter("FakeQuanterWithAbsMaxObserver")
+class _FQAbsMax(FakeQuanterWithAbsMaxObserverLayer):
+    pass
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ collector: tracks the max |x| seen; forward is identity
+    (reference: imperative/ptq_quantizer.py AbsmaxQuantizer)."""
+
+    def __init__(self, bit_length=8, quant_axis=None):
+        super().__init__()
+        self._bits = bit_length
+        self._quant_axis = quant_axis
+        self.register_buffer("_scale", Tensor(jnp.zeros([], jnp.float32)))
+
+    def forward(self, x):
+        if self._quant_axis is None:
+            absmax = jnp.max(jnp.abs(x._array)).astype(jnp.float32)
+        else:
+            axes = tuple(i for i in range(x._array.ndim)
+                         if i != self._quant_axis)
+            absmax = jnp.max(jnp.abs(x._array), axis=axes).astype(
+                jnp.float32)
+            if self._scale._array.ndim == 0:
+                self._scale._array = jnp.zeros_like(absmax)
+        self._scale._array = jnp.maximum(self._scale._array, absmax)
+        return x
+
+    def scales(self):
+        return Tensor(self._scale._array)
+
+
+class MovingAverageAbsmaxObserver(BaseQuanter):
+    """PTQ collector with EMA smoothing
+    (reference: imperative/ptq_quantizer.py KLQuantizer-family sibling)."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9):
+        super().__init__()
+        self._bits = bit_length
+        self._moving_rate = moving_rate
+        self.register_buffer("_scale", Tensor(jnp.zeros([], jnp.float32)))
+        self._seen = False
+
+    def forward(self, x):
+        absmax = jnp.max(jnp.abs(x._array)).astype(jnp.float32)
+        if not self._seen:
+            self._scale._array = absmax
+            self._seen = True
+        else:
+            r = self._moving_rate
+            self._scale._array = self._scale._array * r + absmax * (1 - r)
+        return x
+
+    def scales(self):
+        return Tensor(self._scale._array)

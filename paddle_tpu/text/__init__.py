@@ -1,0 +1,15 @@
+"""paddle.text parity (reference: python/paddle/text/__init__.py).
+
+ViterbiDecoder/viterbi_decode are implemented with lax.scan (static trip
+count, MXU-friendly batched max-sum recursions) instead of the reference's
+CUDA viterbi_decode op (paddle/phi/kernels/gpu/viterbi_decode_kernel.cu).
+Datasets mirror the reference list with hermetic synthetic backends
+(zero-egress environments; same pattern as vision.datasets)."""
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
+
+__all__ = [
+    "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14",
+    "WMT16", "ViterbiDecoder", "viterbi_decode",
+]

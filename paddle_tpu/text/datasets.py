@@ -1,0 +1,160 @@
+"""Text datasets (reference: python/paddle/text/datasets/ — Conll05st,
+Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16).
+
+Network download is unavailable (zero-egress); each dataset loads from a
+local `data_file` when given and otherwise produces a deterministic
+synthetic corpus with the same record structure as the real one — the
+hermetic-CI pattern shared with vision.datasets."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+class _SyntheticTextDataset(Dataset):
+    """Deterministic token-id sequences; subclasses define record shape.
+    Positional order (data_file, mode) matches the reference datasets."""
+
+    def __init__(self, data_file=None, mode="train", seed=0):
+        self.mode = mode
+        self.data_file = data_file
+        self._rng = np.random.RandomState(
+            seed if mode == "train" else seed + 1)
+        self._build()
+
+    def _build(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._records)
+
+    def __getitem__(self, idx):
+        return self._records[idx]
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification: (token_ids, label). reference:
+    text/datasets/imdb.py."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.cutoff = cutoff
+        self.word_idx = {f"w{i}": i for i in range(5000)}
+        super().__init__(data_file, mode, seed=10)
+
+    def _build(self):
+        n = 512 if self.mode == "train" else 128
+        self._records = []
+        for _ in range(n):
+            length = self._rng.randint(8, 64)
+            doc = self._rng.randint(0, 5000, (length,)).astype(np.int64)
+            label = np.int64(self._rng.randint(0, 2))
+            self._records.append((doc, label))
+
+
+class Imikolov(_SyntheticTextDataset):
+    """N-gram LM windows (reference: text/datasets/imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.window_size = window_size
+        self.data_type = data_type
+        self.word_idx = {f"w{i}": i for i in range(2000)}
+        super().__init__(data_file, mode, seed=11)
+
+    def _build(self):
+        n = 1024 if self.mode == "train" else 256
+        if self.data_type == "NGRAM":
+            self._records = [
+                tuple(self._rng.randint(0, 2000, (self.window_size,))
+                      .astype(np.int64))
+                for _ in range(n)]
+        else:  # SEQ
+            self._records = [
+                self._rng.randint(0, 2000,
+                                  (self._rng.randint(4, 20),))
+                .astype(np.int64)
+                for _ in range(n)]
+
+
+class Movielens(_SyntheticTextDataset):
+    """Rating records (user, movie, rating feature tuple). reference:
+    text/datasets/movielens.py."""
+
+    def _build(self):
+        n = 1024 if self.mode == "train" else 256
+        self._records = []
+        for _ in range(n):
+            user_id = np.int64(self._rng.randint(1, 6041))
+            gender = np.int64(self._rng.randint(0, 2))
+            age = np.int64(self._rng.randint(0, 7))
+            job = np.int64(self._rng.randint(0, 21))
+            movie_id = np.int64(self._rng.randint(1, 3953))
+            categories = self._rng.randint(0, 18, (3,)).astype(np.int64)
+            title = self._rng.randint(0, 5000, (4,)).astype(np.int64)
+            rating = np.float32(self._rng.randint(1, 6))
+            self._records.append((user_id, gender, age, job, movie_id,
+                                  categories, title, rating))
+
+
+class UCIHousing(_SyntheticTextDataset):
+    """13 features → price (reference: text/datasets/uci_housing.py)."""
+
+    def _build(self):
+        n = 404 if self.mode == "train" else 102
+        feats = self._rng.randn(n, 13).astype(np.float32)
+        w = self._rng.randn(13).astype(np.float32)
+        prices = (feats @ w + self._rng.randn(n) * 0.1).astype(np.float32)
+        self._records = [(feats[i], prices[i:i + 1]) for i in range(n)]
+
+
+class Conll05st(_SyntheticTextDataset):
+    """SRL records: word/predicate/ctx windows + mark + labels.
+    reference: text/datasets/conll05.py."""
+
+    def _build(self):
+        n = 256 if self.mode == "train" else 64
+        self._records = []
+        for _ in range(n):
+            length = self._rng.randint(5, 30)
+            word = self._rng.randint(0, 44068, (length,)).astype(np.int64)
+            pred = np.full((length,), self._rng.randint(0, 3162),
+                           np.int64)
+            ctx = [self._rng.randint(0, 44068, (length,)).astype(np.int64)
+                   for _ in range(5)]
+            mark = self._rng.randint(0, 2, (length,)).astype(np.int64)
+            label = self._rng.randint(0, 59, (length,)).astype(np.int64)
+            self._records.append((word, *ctx, pred, mark, label))
+
+
+class _WMTBase(_SyntheticTextDataset):
+    src_vocab = 30000
+    trg_vocab = 30000
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        self.dict_size = dict_size if dict_size > 0 else self.src_vocab
+        super().__init__(data_file, mode, seed=13)
+
+    def _build(self):
+        n = 256 if self.mode == "train" else 64
+        self._records = []
+        for _ in range(n):
+            sl = self._rng.randint(4, 25)
+            tl = self._rng.randint(4, 25)
+            src = self._rng.randint(0, self.dict_size, (sl,)) \
+                .astype(np.int64)
+            trg = self._rng.randint(0, self.dict_size, (tl,)) \
+                .astype(np.int64)
+            trg_next = np.concatenate([trg[1:], [1]]).astype(np.int64)
+            self._records.append((src, trg, trg_next))
+
+
+class WMT14(_WMTBase):
+    """reference: text/datasets/wmt14.py."""
+
+
+class WMT16(_WMTBase):
+    """reference: text/datasets/wmt16.py."""

@@ -1,0 +1,94 @@
+"""Viterbi decoding for CRF-style sequence labelling.
+
+Reference: python/paddle/text/viterbi_decode.py (ViterbiDecoder layer →
+_C_ops.viterbi_decode, CUDA kernel at
+paddle/phi/kernels/gpu/viterbi_decode_kernel.cu).
+
+TPU-native: the time recursion is a lax.scan over the sequence axis; each
+step is a batched [B, T, T] max-sum — dense, static-shape work the VPU/MXU
+handle well. Backtracking is a second (reversed) scan over the argmax
+history."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..core.tensor import Tensor, apply_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_arrays(potentials, transition, lengths, include_bos_eos_tag):
+    """potentials [B, L, N] fp, transition [N, N], lengths [B] int."""
+    B, L, N = potentials.shape
+    pots = jnp.swapaxes(potentials, 0, 1)  # [L, B, N]
+    steps = jnp.arange(1, L)
+
+    if include_bos_eos_tag:
+        # reference semantics: tag N-2 is BOS, N-1 is EOS — neither can be
+        # emitted at any timestep, so penalize them in every potential
+        tag_mask = jnp.full((N,), -1e4).at[:N - 2].set(0.0)
+        pots = pots + tag_mask[None, None, :]
+        alpha0 = pots[0] + transition[N - 2][None, :]
+    else:
+        alpha0 = pots[0]
+
+    def step(alpha, t):
+        # alpha [B, N]; candidate scores [B, prev N, next N]
+        scores = alpha[:, :, None] + transition[None, :, :] \
+            + pots[t][:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)          # [B, N]
+        new_alpha = jnp.max(scores, axis=1)             # [B, N]
+        # sequences already past their length keep their alpha
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return new_alpha, (best_prev, active)
+
+    alpha, (history, actives) = lax.scan(step, alpha0, steps)
+
+    if include_bos_eos_tag:
+        alpha = alpha + transition[:, N - 1][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)               # [B]
+
+    def back(tag, hist_active):
+        hist, active = hist_active                      # [B, N], [B, 1]
+        prev = jnp.take_along_axis(hist, tag[:, None], axis=1)[:, 0]
+        tag_new = jnp.where(active[:, 0], prev, tag)
+        return tag_new, tag
+
+    _, path_rev = lax.scan(back, last_tag, (history, actives),
+                           reverse=True)
+    first_tag = _
+    path = jnp.concatenate([first_tag[None], path_rev], axis=0)  # [L, B]
+    path = jnp.swapaxes(path, 0, 1)                     # [B, L]
+    # zero-pad beyond each sequence's length (reference returns only the
+    # valid prefix per row; with static shapes we mask instead)
+    mask = jnp.arange(L)[None, :] < lengths[:, None]
+    path = jnp.where(mask, path, 0)
+    return scores, path.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Returns (scores [B], paths [B, L]) — reference
+    python/paddle/text/viterbi_decode.py:viterbi_decode."""
+    return apply_op(
+        lambda p, t, l: _viterbi_arrays(p, t, l, include_bos_eos_tag),
+        potentials, transition_params, lengths, op_name="viterbi_decode",
+        n_outs=2)
+
+
+class ViterbiDecoder(nn.Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

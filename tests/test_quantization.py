@@ -1,0 +1,140 @@
+"""QAT/PTQ tests (mirrors reference test_quantization suites:
+python/paddle/fluid/tests/unittests/test_imperative_qat*.py)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QuantConfig, QAT, PTQ, FakeQuanterWithAbsMaxObserver, AbsmaxObserver,
+    QuanterFactory, QuantedWrapper, fake_quant_dequant, quant_tensor,
+    dequant_tensor, convert)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_fake_quant_dequant_roundtrip():
+    x = jnp.linspace(-1.0, 1.0, 101)
+    out = fake_quant_dequant(x, jnp.asarray(1.0), bits=8)
+    # 8-bit symmetric on absmax-1 data: error bounded by scale/qmax/2
+    assert float(jnp.max(jnp.abs(out - x))) <= 1.0 / 127 / 2 + 1e-7
+    q = quant_tensor(x, jnp.asarray(1.0))
+    assert q.dtype == jnp.int8
+    deq = dequant_tensor(q, 1.0)
+    np.testing.assert_allclose(np.asarray(deq), np.asarray(out), atol=1e-7)
+
+
+def test_fake_quant_ste_gradient():
+    import jax
+
+    def f(x):
+        return jnp.sum(fake_quant_dequant(x, jnp.asarray(1.0)))
+
+    g = jax.grad(f)(jnp.array([0.5, -0.3, 2.0, -5.0]))
+    # inside the clip range grad passes; saturated elements get zero
+    np.testing.assert_allclose(np.asarray(g), [1.0, 1.0, 0.0, 0.0])
+
+
+def test_qat_quantize_wraps_linears():
+    model = _mlp()
+    q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+    cfg = QuantConfig(activation=q, weight=q)
+    qat_model = QAT(cfg).quantize(model, inplace=False)
+    wrapped = [s for s in qat_model.sublayers()
+               if isinstance(s, QuantedWrapper)]
+    assert len(wrapped) == 2
+    # original model untouched
+    assert not any(isinstance(s, QuantedWrapper)
+                   for s in model.sublayers())
+
+
+def test_qat_trains_and_converges():
+    model = _mlp()
+    q = FakeQuanterWithAbsMaxObserver()
+    qat_model = QAT(QuantConfig(activation=q, weight=q)).quantize(model)
+    opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                parameters=qat_model.parameters())
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((64, 8)).astype(np.float32)
+    ys = (xs @ rng.standard_normal((8, 4)).astype(np.float32))
+    first = last = None
+    for _ in range(30):
+        x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+        loss = nn.MSELoss()(qat_model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        last = float(loss.numpy())
+        first = first if first is not None else last
+    assert last < first * 0.5, (first, last)
+
+
+def test_convert_freezes_and_unwraps():
+    model = _mlp()
+    q = FakeQuanterWithAbsMaxObserver()
+    qat_model = QAT(QuantConfig(activation=q, weight=q)).quantize(model)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    qat_model(x)  # one observation pass
+    converted = convert(qat_model, inplace=False)
+    assert not any(isinstance(s, QuantedWrapper)
+                   for s in converted.sublayers())
+    linears = [s for s in converted.sublayers()
+               if isinstance(s, nn.Linear)]
+    assert all(hasattr(l, "weight_scale") for l in linears)
+    converted.eval()
+    out = converted(x)
+    assert tuple(out.shape) == (2, 4)
+
+
+def test_per_channel_quanter():
+    from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserverLayer
+    q = FakeQuanterWithAbsMaxObserverLayer(quant_axis=0)
+    x = paddle.to_tensor(np.stack([np.ones(8, np.float32) * 0.1,
+                                   np.ones(8, np.float32) * 10.0]))
+    q(x)
+    scales = np.asarray(q.scales().numpy())
+    assert scales.shape == (2,)
+    assert scales[1] > scales[0] * 10  # channel scales track channel absmax
+
+
+def test_ptq_calibrate_then_convert():
+    model = _mlp()
+    model.eval()
+    ptq = PTQ()
+    observed = ptq.quantize(model, inplace=False)
+    rng = np.random.default_rng(1)
+    for _ in range(4):
+        observed(paddle.to_tensor(
+            rng.standard_normal((16, 8)).astype(np.float32)))
+    converted = ptq.convert(observed)
+    converted.eval()
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    ref = model(x)
+    out = converted(x)
+    # int8 PTQ on a 2-layer MLP: outputs close to fp32 reference...
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), atol=0.2)
+    # ...but NOT identical — convert must bake real quantization error
+    assert float(np.abs(np.asarray(out.numpy())
+                        - np.asarray(ref.numpy())).max()) > 0
+
+
+def test_qat_respects_type_config():
+    model = _mlp()
+    cfg = QuantConfig()
+    q = FakeQuanterWithAbsMaxObserver()
+    cfg.add_type_config(nn.Linear, activation=q, weight=q)
+    qat_model = QAT(cfg).quantize(model)
+    assert sum(isinstance(s, QuantedWrapper)
+               for s in qat_model.sublayers()) == 2
+
+
+def test_qat_requires_train_mode():
+    model = _mlp()
+    model.eval()
+    q = FakeQuanterWithAbsMaxObserver()
+    with pytest.raises(AssertionError):
+        QAT(QuantConfig(activation=q, weight=q)).quantize(model)

@@ -1,0 +1,212 @@
+"""Tests for paddle.text / paddle.audio / incubate.asp parity packages."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+
+def _np_viterbi(emissions, transition, length):
+    """Plain-python reference for one sequence, no bos/eos tags."""
+    L, N = emissions.shape
+    score = emissions[0].copy()
+    history = []
+    for t in range(1, length):
+        cand = score[:, None] + transition + emissions[t][None, :]
+        history.append(np.argmax(cand, axis=0))
+        score = np.max(cand, axis=0)
+    best = int(np.argmax(score))
+    path = [best]
+    for h in reversed(history):
+        best = int(h[best])
+        path.append(best)
+    return float(np.max(score)), list(reversed(path))
+
+
+def test_viterbi_decode_matches_reference():
+    rng = np.random.default_rng(0)
+    B, L, N = 3, 7, 5
+    pots = rng.standard_normal((B, L, N)).astype(np.float32)
+    trans = rng.standard_normal((N, N)).astype(np.float32)
+    lengths = np.array([7, 5, 3], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pots), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=False)
+    for b in range(B):
+        ref_score, ref_path = _np_viterbi(pots[b], trans, int(lengths[b]))
+        np.testing.assert_allclose(float(scores.numpy()[b]), ref_score,
+                                   rtol=1e-5)
+        got = list(np.asarray(paths.numpy())[b][:int(lengths[b])])
+        assert got == ref_path, (b, got, ref_path)
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.default_rng(1)
+    trans = rng.standard_normal((6, 6)).astype(np.float32)
+    dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans))
+    pots = paddle.to_tensor(rng.standard_normal((2, 5, 6)).astype(
+        np.float32))
+    lengths = paddle.to_tensor(np.array([5, 4], np.int64))
+    scores, paths = dec(pots, lengths)
+    assert tuple(paths.shape) == (2, 5)
+    # bos/eos tags reserve the last two ids; emitted tags must avoid them
+    assert np.asarray(paths.numpy()).max() < 4
+
+
+def test_text_datasets():
+    for cls in [paddle.text.Imdb, paddle.text.Imikolov,
+                paddle.text.Movielens, paddle.text.UCIHousing,
+                paddle.text.Conll05st, paddle.text.WMT14,
+                paddle.text.WMT16]:
+        train = cls(mode="train")
+        test = cls(mode="test")
+        assert len(train) > len(test) > 0
+        rec = train[0]
+        assert isinstance(rec, tuple) and len(rec) >= 2
+    # loader integration
+    from paddle_tpu.io import DataLoader
+    ds = paddle.text.UCIHousing(mode="train")
+    batch = next(iter(DataLoader(ds, batch_size=16)))
+    assert batch[0].shape[0] == 16 and batch[0].shape[1] == 13
+
+
+# ---------------------------------------------------------------------------
+# audio
+# ---------------------------------------------------------------------------
+
+def test_mel_conversions_roundtrip():
+    F = paddle.audio.functional
+    freqs = jnp.asarray([100.0, 440.0, 1000.0, 4000.0])
+    back = F.mel_to_hz(F.hz_to_mel(freqs))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(freqs),
+                               rtol=1e-4)
+    # htk variant
+    back = F.mel_to_hz(F.hz_to_mel(freqs, htk=True), htk=True)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(freqs),
+                               rtol=1e-4)
+
+
+def test_fbank_matrix_shape_and_coverage():
+    F = paddle.audio.functional
+    fb = F.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert float(jnp.sum(fb)) > 0
+    # every filter has non-negative weights
+    assert float(jnp.min(fb)) >= 0
+
+
+def test_windows():
+    F = paddle.audio.functional
+    for win in ["hann", "hamming", "blackman", "bartlett", "bohman",
+                "cosine", ("gaussian", 7), ("exponential", None, 1.0),
+                ("kaiser", 12.0), ("tukey", 0.5)]:
+        w = F.get_window(win, 128)
+        assert w.shape == (128,)
+        assert np.isfinite(np.asarray(w)).all()
+    # hann periodic window matches numpy's within fft symmetry
+    w = F.get_window("hann", 8)
+    np.testing.assert_allclose(np.asarray(w), np.hanning(9)[:-1],
+                               atol=1e-6)
+
+
+def test_spectrogram_and_mfcc_layers():
+    sr = 16000
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    sig = np.sin(2 * np.pi * 440 * t)[None, :]  # [1, T]
+    x = paddle.to_tensor(sig)
+    spec = paddle.audio.features.Spectrogram(n_fft=512)(x)
+    assert spec.shape[1] == 257
+    mel = paddle.audio.features.MelSpectrogram(sr=sr, n_fft=512,
+                                               n_mels=64)(x)
+    assert mel.shape[1] == 64
+    logmel = paddle.audio.features.LogMelSpectrogram(sr=sr, n_fft=512,
+                                                     n_mels=64)(x)
+    assert np.isfinite(np.asarray(logmel.numpy())).all()
+    mfcc = paddle.audio.features.MFCC(sr=sr, n_mfcc=20, n_fft=512)(x)
+    assert mfcc.shape[1] == 20
+    # 440 Hz bin should dominate the power spectrum
+    s = np.asarray(spec.numpy())[0]
+    peak_bin = int(np.argmax(s.mean(axis=1)))
+    assert abs(peak_bin - round(440 * 512 / sr)) <= 1
+
+
+def test_audio_backend_roundtrip(tmp_path):
+    sr = 8000
+    data = (np.sin(np.linspace(0, 100, 4000))[None, :]
+            .astype(np.float32) * 0.5)
+    f = str(tmp_path / "t.wav")
+    paddle.audio.save(f, data, sr)
+    info = paddle.audio.info(f)
+    assert info.sample_rate == sr and info.num_channels == 1
+    loaded, sr2 = paddle.audio.load(f)
+    assert sr2 == sr
+    np.testing.assert_allclose(loaded, data, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# asp
+# ---------------------------------------------------------------------------
+
+def test_mask_1d_properties():
+    from paddle_tpu.incubate import asp
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((16, 32)).astype(np.float32)
+    mask = asp.get_mask_1d(w, 2, 4)
+    assert asp.check_mask_1d(mask, 2, 4)
+    assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+    # keeps the largest-|.| entries of each group of 4
+    grouped = np.abs(w).reshape(-1, 4)
+    kept = (mask.reshape(-1, 4) > 0)
+    for g, k in zip(grouped, kept):
+        assert set(np.argsort(g)[-2:]) == set(np.where(k)[0])
+
+
+def test_mask_2d_greedy_and_best():
+    from paddle_tpu.incubate import asp
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((8, 8)).astype(np.float32)
+    for fn in [asp.get_mask_2d_greedy, asp.get_mask_2d_best]:
+        mask = fn(w, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+
+
+def test_prune_model_and_training_keeps_sparsity():
+    from paddle_tpu.incubate import asp
+    asp.reset_excluded_layers()
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(model, n=2, m=4)
+    assert len(masks) == 2
+    for _, p in model.named_parameters():
+        if p.ndim == 2:
+            assert asp.check_sparsity(np.asarray(p._array))
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    rng = np.random.default_rng(4)
+    for _ in range(3):
+        x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(
+            np.float32))
+        loss = paddle.mean(model(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for _, p in model.named_parameters():
+        if p.ndim == 2:
+            assert asp.check_sparsity(np.asarray(p._array)), \
+                "sparsity lost after training steps"
+
+
+def test_excluded_layers():
+    from paddle_tpu.incubate import asp
+    model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(["0."])
+    try:
+        masks = asp.prune_model(model)
+        assert len(masks) == 1
+    finally:
+        asp.reset_excluded_layers()
