@@ -16,9 +16,11 @@ from ..core.tensor import Tensor, apply_op
 from ..framework.random import next_key
 from ..ops.registry import _ensure_tensor
 
-__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
-           "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
-           "LogNormal", "Multinomial", "kl_divergence", "register_kl"]
+__all__ = ["Distribution", "ExponentialFamily", "Normal", "Uniform",
+           "Categorical", "Bernoulli", "Beta", "Dirichlet", "Exponential",
+           "Gamma", "Gumbel", "Laplace", "LogNormal", "Multinomial",
+           "Independent", "TransformedDistribution", "kl_divergence",
+           "register_kl", "transform"]
 
 
 def _arr(x):
@@ -294,6 +296,131 @@ class Multinomial(Distribution):
                       + jnp.sum(v * logits, axis=-1))
 
 
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family distributions; entropy via the
+    Bregman-divergence identity is replaced by each subclass's closed
+    form (reference: python/paddle/distribution/exponential_family.py)."""
+
+
+class Gumbel(Distribution):
+    """reference: python/paddle/distribution/gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * 0.57721566490153286)
+
+    @property
+    def variance(self):
+        return Tensor((math.pi ** 2 / 6) * self.scale ** 2)
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt((math.pi ** 2 / 6)) * self.scale)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.gumbel(next_key(), shp) * self.scale
+                      + self.loc)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1.0 + 0.57721566490153286
+                      + jnp.zeros(self._batch_shape))
+
+
+class Independent(Distribution):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` batch dims of
+    `base` as event dims (reference:
+    python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        assert 0 < self.rank <= len(base.batch_shape), \
+            "reinterpreted_batch_rank must be in (0, len(batch_shape)]"
+        bshape = tuple(base.batch_shape)
+        super().__init__(bshape[:len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._array
+        for _ in range(self.rank):
+            lp = jnp.sum(lp, axis=-1)
+        return Tensor(lp)
+
+    def entropy(self):
+        e = self.base.entropy()._array
+        for _ in range(self.rank):
+            e = jnp.sum(e, axis=-1)
+        return Tensor(e)
+
+
+class TransformedDistribution(Distribution):
+    """Push a base distribution through a chain of transforms
+    (reference: python/paddle/distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        from . import transform as T
+        self.base = base
+        self.transforms = list(transforms)
+        for t in self.transforms:
+            assert isinstance(t, T.Transform), \
+                "transforms must be distribution.transform.Transform"
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        for t in self.transforms:
+            shape = tuple(t.forward_shape(shape))
+        # conservatively treat everything beyond base batch as event
+        nb = len(base.batch_shape)
+        super().__init__(shape[:nb], shape[nb:])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)._array
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)._array
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    def log_prob(self, value):
+        # event_dim bookkeeping follows the standard transformed-dist
+        # recursion: a transform's log-det comes back with its OWN domain
+        # event dims already reduced, so only the surplus event dims (from
+        # the overall event shape) are summed here — never both.
+        from .transform import _sum_event
+        y = _arr(value)
+        lp = 0.0
+        event_dim = len(self._event_shape)
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            event_dim += t._domain_event_dim - t._codomain_event_dim
+            lp = lp - _sum_event(t._forward_log_det_jacobian(x),
+                                 event_dim - t._domain_event_dim)
+            y = x
+        base_lp = _sum_event(self.base.log_prob(Tensor(y))._array,
+                             event_dim - len(self.base.event_shape))
+        return Tensor(lp + base_lp)
+
+
 _KL_REGISTRY = {}
 
 
@@ -338,3 +465,6 @@ def _kl_bernoulli(p, q):
     qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
     return Tensor(pp * jnp.log(pp / qq)
                   + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
+
+
+from . import transform  # noqa: E402,F401 — paddle.distribution.transform

@@ -1,0 +1,332 @@
+"""Bijective transforms (reference: python/paddle/distribution/transform.py
+— Transform base with forward/inverse/log_det_jacobian, AffineTransform,
+ExpTransform, SigmoidTransform, TanhTransform, PowerTransform,
+AbsTransform, ChainTransform, SoftmaxTransform, StickBreakingTransform,
+IndependentTransform, ReshapeTransform, StackTransform).
+
+Pure-jnp elementwise math; every transform also drives
+TransformedDistribution's log_prob/sample."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Type", "Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.OTHER
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _arr(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return list(shape)
+
+    def inverse_shape(self, shape):
+        return list(shape)
+
+    # event dimensionality consumed/produced (0 = elementwise)
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+
+class AbsTransform(Transform):
+    """y = |x| (reference: transform.py AbsTransform). Surjective — the
+    conventional inverse returns the positive branch."""
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax_sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+def jax_sigmoid(x):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jnp.logaddexp(0.0, -2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """x → softmax(x) over the last axis; inverse is log (up to an
+    additive constant) — reference: transform.py SoftmaxTransform."""
+    _type = Type.OTHER
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        z = x - jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(z)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not a bijection; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} → K-simplex via stick breaking
+    (reference: transform.py StickBreakingTransform)."""
+    _type = Type.BIJECTION
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax_sigmoid(x - offset)
+        z_cumprod = jnp.cumprod(1 - z, axis=-1)
+        head = z * jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), z_cumprod[..., :-1]], axis=-1)
+        tail = z_cumprod[..., -1:]
+        return jnp.concatenate([head, tail], axis=-1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        y_crop = y[..., :-1]
+        rem = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        rem = jnp.concatenate([jnp.ones_like(y_crop[..., :1]),
+                               rem[..., :-1]], axis=-1)
+        z = y_crop / rem
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = x - offset
+        # sum over event dim of log sigmoid'(z) + log remaining stick
+        log_sig = -jnp.logaddexp(0.0, -z)
+        log_one_minus_sig = -jnp.logaddexp(0.0, z)
+        cum = jnp.cumsum(log_one_minus_sig[..., :-1], axis=-1)
+        cum = jnp.concatenate([jnp.zeros_like(cum[..., :1]), cum], axis=-1)
+        return jnp.sum(log_sig + log_one_minus_sig + cum, axis=-1)
+
+    def forward_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] + 1]
+
+    def inverse_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] - 1]
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._type = Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms) \
+            else Type.OTHER
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + _sum_event(t._forward_log_det_jacobian(x),
+                                       t._domain_event_dim)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+def _sum_event(x, event_dim):
+    for _ in range(event_dim):
+        x = jnp.sum(x, axis=-1)
+    return x
+
+
+class IndependentTransform(Transform):
+    """Reinterprets batch dims of a base transform as event dims
+    (reference: transform.py IndependentTransform)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+        self._domain_event_dim = base._domain_event_dim + self.rank
+        self._codomain_event_dim = base._codomain_event_dim + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sum_event(self.base._forward_log_det_jacobian(x),
+                          self.rank)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_dim = len(self.in_event_shape)
+        self._codomain_event_dim = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return list(shape[:-n]) + list(self.out_event_shape)
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return list(shape[:-n]) + list(self.in_event_shape)
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms along slices of `axis`
+    (reference: transform.py StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

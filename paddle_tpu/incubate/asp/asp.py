@@ -90,10 +90,19 @@ class OptimizerWithSparsityGuarantee:
     def __getattr__(self, item):
         return getattr(self._optimizer, item)
 
+    def _params(self):
+        return getattr(self._optimizer, "_parameter_list", None) or []
+
     def step(self):
         self._optimizer.step()
-        params = getattr(self._optimizer, "_parameter_list", None) or []
-        ASPHelper.reapply_masks(params)
+        ASPHelper.reapply_masks(self._params())
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self._optimizer.minimize(loss, startup_program, parameters,
+                                       no_grad_set)
+        ASPHelper.reapply_masks(self._params())
+        return out
 
     def clear_grad(self, *a, **k):
         return self._optimizer.clear_grad(*a, **k)

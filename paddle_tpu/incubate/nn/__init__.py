@@ -9,3 +9,4 @@ shapes qualify.
 from . import functional
 from .fused_transformer import (FusedMultiHeadAttention, FusedFeedForward,
                                 FusedTransformerEncoderLayer)
+from .fused_linear import FusedLinear, FusedEcMoe

@@ -32,7 +32,11 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
     return apply_op(_f, *args, op_name="fused_matmul_bias")
 
 
-fused_linear = fused_matmul_bias
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference: incubate/nn/functional/fused_matmul_bias.py
+    fused_linear."""
+    return fused_matmul_bias(x, weight, bias,
+                             transpose_y=transpose_weight)
 
 
 def swiglu(x, y=None, name=None):

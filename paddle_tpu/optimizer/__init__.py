@@ -1,3 +1,4 @@
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, Adam, AdamW,
                         Adamax, RMSProp, Adadelta, Lamb)
+from .lbfgs import LBFGS
 from . import lr

@@ -1,0 +1,194 @@
+"""L-BFGS optimizer (reference: python/paddle/incubate/optimizer/lbfgs.py
+— closure-driven LBFGS with two-loop recursion and optional strong-Wolfe
+line search).
+
+The inner direction math runs on-device in fp32 (dots and axpys — XLA
+fuses the two-loop recursion); only the loop control is host-side, which
+matches the reference's Python implementation."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import no_grad
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _flat(arrays):
+    return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                            for a in arrays])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._prev_flat_grad = None
+
+    # -- flat views --------------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _gather(self):
+        return _flat([p._array for p in self._params()])
+
+    def _gather_grad(self):
+        gs = []
+        for p in self._params():
+            g = p.grad
+            gs.append(jnp.zeros_like(p._array) if g is None else g._array)
+        return _flat(gs)
+
+    def _scatter(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(p._array.size)
+            p._set_array(flat[off:off + n].reshape(p._array.shape)
+                         .astype(p._array.dtype))
+            off += n
+
+    # -- closure evaluation ------------------------------------------------
+    def _evaluate(self, closure, x):
+        self._scatter(x)
+        self.clear_grad()
+        loss = closure()
+        return float(loss.numpy()), self._gather_grad()
+
+    def _direction(self, g):
+        """Two-loop recursion over the (s, y) history."""
+        q = -g
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / float(jnp.dot(y, s))
+            a = rho * float(jnp.dot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s_hist:
+            s, y = self._s_hist[-1], self._y_hist[-1]
+            gamma = float(jnp.dot(s, y)) / float(jnp.dot(y, y))
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.dot(y, q))
+            q = q + (a - b) * s
+        return q
+
+    @no_grad()
+    def step(self, closure=None):
+        assert closure is not None, \
+            "LBFGS.step requires a closure that recomputes the loss"
+        import paddle_tpu as _p
+
+        def closure_with_grad():
+            with _p.enable_grad():
+                return closure()
+
+        x = self._gather()
+        loss, g = self._evaluate(closure_with_grad, x)
+        evals = 1
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
+                break
+            d = self._direction(g)
+            t = float(self.get_lr())
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-15:  # not a descent direction: reset history
+                self._s_hist.clear()
+                self._y_hist.clear()
+                d = -g
+                gtd = float(jnp.dot(g, d))
+            if self.line_search_fn == "strong_wolfe":
+                loss_new, g_new, t, ls_evals = self._strong_wolfe(
+                    closure_with_grad, x, d, t, loss, g, gtd)
+                evals += ls_evals
+            else:
+                x_new = x + t * d
+                loss_new, g_new = self._evaluate(closure_with_grad, x_new)
+                evals += 1
+            x_new = x + t * d
+            s = x_new - x
+            y = g_new - g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if abs(loss_new - loss) < self.tolerance_change or \
+               float(jnp.max(jnp.abs(s))) < self.tolerance_change:
+                x, loss, g = x_new, loss_new, g_new
+                break
+            x, loss, g = x_new, loss_new, g_new
+            if evals >= self.max_eval:
+                break
+        self._scatter(x)
+        self._step_count += 1
+        from ..core.tensor import Tensor
+        return Tensor(jnp.asarray(loss))
+
+    def _strong_wolfe(self, closure, x, d, t, f0, g0, gtd0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Backtracking-then-zoom strong Wolfe line search
+        (reference: lbfgs.py _strong_wolfe)."""
+        evals = 0
+        t_prev, f_prev, g_prev = 0.0, f0, g0
+        f_new, g_new = f0, g0
+        for i in range(max_ls):
+            f_new, g_new = self._evaluate(closure, x + t * d)
+            evals += 1
+            gtd_new = float(jnp.dot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (i > 0 and f_new >= f_prev):
+                # zoom between t_prev and t
+                lo, hi = t_prev, t
+                f_lo = f_prev
+                for _ in range(max_ls):
+                    t_mid = 0.5 * (lo + hi)
+                    f_mid, g_mid = self._evaluate(closure, x + t_mid * d)
+                    evals += 1
+                    gtd_mid = float(jnp.dot(g_mid, d))
+                    if f_mid > f0 + c1 * t_mid * gtd0 or f_mid >= f_lo:
+                        hi = t_mid
+                    else:
+                        if abs(gtd_mid) <= -c2 * gtd0:
+                            return f_mid, g_mid, t_mid, evals
+                        if gtd_mid * (hi - lo) >= 0:
+                            hi = lo
+                        lo, f_lo = t_mid, f_mid
+                    if abs(hi - lo) < 1e-9:
+                        break
+                return f_mid, g_mid, t_mid, evals
+            if abs(gtd_new) <= -c2 * gtd0:
+                return f_new, g_new, t, evals
+            if gtd_new >= 0:
+                lo, hi = t, t_prev
+                for _ in range(max_ls):
+                    t_mid = 0.5 * (lo + hi)
+                    f_mid, g_mid = self._evaluate(closure, x + t_mid * d)
+                    evals += 1
+                    gtd_mid = float(jnp.dot(g_mid, d))
+                    if f_mid > f0 + c1 * t_mid * gtd0:
+                        hi = t_mid
+                    else:
+                        if abs(gtd_mid) <= -c2 * gtd0:
+                            return f_mid, g_mid, t_mid, evals
+                        lo = t_mid
+                    if abs(hi - lo) < 1e-9:
+                        break
+                return f_mid, g_mid, t_mid, evals
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = t * 2.0
+        # exhausted max_ls: t_prev is the point (f_new, g_new) was last
+        # evaluated at — return that, not the speculatively doubled t
+        return f_new, g_new, t_prev, evals

@@ -56,7 +56,9 @@ def quant_tensor(x, scale, bits=8):
     """True quantization to int (for export); no gradient."""
     qmax = 2 ** (bits - 1) - 1
     s = jnp.maximum(scale, 1e-9)
-    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax).astype(jnp.int8)
+    out_dtype = jnp.int8 if bits <= 8 else \
+        jnp.int16 if bits <= 16 else jnp.int32
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax).astype(out_dtype)
 
 
 def dequant_tensor(q, scale, bits=8, dtype=jnp.float32):

@@ -39,12 +39,14 @@ class QuantedWrapper(Layer):
                                                        "weight"):
             w = self._layer.weight
             qw = self.weight_quanter(w)
-            orig = w._array
-            w._array = qw._array
+            # substitute the quantized TENSOR (not just its array) so the
+            # inner layer's ops consume the fake-quant tape node and the
+            # STE backward reaches w; swapping w._array would sever it
+            object.__setattr__(self._layer, "weight", qw)
             try:
                 return self._layer(x, *args, **kwargs)
             finally:
-                w._array = orig
+                object.__setattr__(self._layer, "weight", w)
         return self._layer(x, *args, **kwargs)
 
 

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, to_tensor, apply_op
 from . import creation, math, logic, manipulation, linalg, search, random, \
-    attribute, einsum as einsum_mod
+    attribute, einsum as einsum_mod, extras
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
@@ -19,7 +19,10 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
-from .attribute import shape as shape_op, rank  # noqa: F401
+from .extras import *  # noqa: F401,F403
+from .attribute import shape, rank  # noqa: F401
+
+shape_op = shape  # legacy internal alias
 from .einsum import einsum  # noqa: F401
 
 from .math import (add, subtract, multiply, divide, floor_divide, mod, pow,
@@ -34,7 +37,7 @@ from .manipulation import cast as _cast_fn
 # ---------------------------------------------------------------------------
 
 _METHOD_SOURCES = [creation, math, logic, manipulation, linalg, search,
-                   random, einsum_mod]
+                   random, einsum_mod, extras]
 
 # ops whose first arg isn't the tensor / that shouldn't become methods
 _SKIP_METHODS = {
@@ -44,6 +47,8 @@ _SKIP_METHODS = {
     "uniform", "normal", "gaussian", "standard_normal", "scatter_nd",
     "add_n", "multiplex", "broadcast_tensors", "multi_dot", "einsum",
     "searchsorted", "concat", "stack", "where",
+    "create_array", "array_write", "array_read", "array_length",
+    "broadcast_shape", "create_tensor", "set_printoptions",
 }
 
 
@@ -65,17 +70,15 @@ def _install_methods():
     Tensor.mm = linalg.matmul
     Tensor.norm = linalg.norm
     Tensor.where = lambda self, x, y: manipulation.where(self, x, y)
-    Tensor.add_ = lambda self, y: self._set_array(self._array + _arr(y))
-    Tensor.subtract_ = lambda self, y: self._set_array(self._array - _arr(y))
-    Tensor.multiply_ = lambda self, y: self._set_array(self._array * _arr(y))
-    Tensor.scale_ = lambda self, s=1.0, bias=0.0: self._set_array(
-        self._array * jnp.asarray(s, self._array.dtype)
-        + jnp.asarray(bias, self._array.dtype))
-    Tensor.zero_ = lambda self: self._set_array(jnp.zeros_like(self._array))
-    Tensor.fill_ = lambda self, v: self._set_array(
-        jnp.full_like(self._array, v))
-    Tensor.clip_ = lambda self, min=None, max=None: self._set_array(
-        jnp.clip(self._array, min, max))
+    # inplace methods share the tape-aware extras implementations — one
+    # semantics for paddle.add_(x, y) and x.add_(y)
+    Tensor.add_ = extras.add_
+    Tensor.subtract_ = extras.subtract_
+    Tensor.multiply_ = extras.multiply_
+    Tensor.scale_ = extras.scale_
+    Tensor.zero_ = extras.zero_
+    Tensor.fill_ = extras.fill_
+    Tensor.clip_ = extras.clip_
     Tensor.exponential_ = random.exponential_
     Tensor.uniform_ = random.uniform_
     Tensor.normal_ = random.normal_

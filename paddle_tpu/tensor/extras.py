@@ -1,0 +1,318 @@
+"""Remaining paddle.tensor surface: inplace variants, TensorArray ops,
+and assorted math/manipulation stragglers.
+
+Reference analog: the `*_` inplace methods patched in
+python/paddle/fluid/dygraph/varbase_patch_methods.py + math_op_patch.py,
+tensor/array.py (array_read/array_write/array_length/create_array),
+tensor/creation.py (create_tensor), tensor/math.py (addmm, frexp,
+nanmedian, nanquantile...), tensor/manipulation.py (take, vsplit,
+reverse, strided_slice...).
+
+Inplace on a functional core: each `op_`(x, ...) applies the functional
+op to a tape snapshot of x and rebinds x to the result, so autograd sees
+a well-formed node (the reference's inplace-version-counter machinery
+collapses to this snapshot/rebind pair — see core.tensor.tape_snapshot).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op, tape_snapshot, rebind_inplace
+from . import linalg as _linalg
+from . import manipulation as _manip
+from . import math as _math
+
+__all__ = [
+    # inplace (scatter_/reshape_/fill_diagonal_ live in manipulation.py,
+    # uniform_/normal_/exponential_ in random.py — not duplicated here)
+    "add_", "subtract_", "multiply_", "divide_", "ceil_", "clip_",
+    "erfinv_", "exp_", "flatten_", "floor_", "index_add_", "lerp_",
+    "put_along_axis_", "reciprocal_", "remainder_", "round_", "scale_",
+    "sqrt_", "squeeze_", "tanh_", "unsqueeze_", "zero_", "fill_",
+    # aliases & stragglers
+    "mm", "inverse", "addmm", "frexp", "nanmedian", "nanquantile",
+    "take", "vsplit", "hsplit", "dsplit", "reverse", "strided_slice",
+    "broadcast_shape", "lu_unpack", "erfinv",
+    "is_complex", "is_floating_point", "is_integer", "set_printoptions",
+    # TensorArray (static-graph parity)
+    "create_array", "array_write", "array_read", "array_length",
+    "create_tensor",
+]
+
+
+# ---------------------------------------------------------------------------
+# inplace machinery
+# ---------------------------------------------------------------------------
+
+def _inplace(fn):
+    """Lift a functional op into its `op_` variant."""
+    def op_(x, *args, **kwargs):
+        snap = tape_snapshot(x)
+        out = fn(snap, *args, **kwargs)
+        rebind_inplace(x, out)
+        return x
+    return op_
+
+
+add_ = _inplace(_math.add)
+subtract_ = _inplace(_math.subtract)
+multiply_ = _inplace(_math.multiply)
+divide_ = _inplace(_math.divide)
+ceil_ = _inplace(_math.ceil)
+clip_ = _inplace(_math.clip)
+exp_ = _inplace(_math.exp)
+floor_ = _inplace(_math.floor)
+lerp_ = _inplace(_math.lerp)
+reciprocal_ = _inplace(_math.reciprocal)
+remainder_ = _inplace(_math.remainder)
+round_ = _inplace(_math.round)
+scale_ = _inplace(_math.scale)
+sqrt_ = _inplace(_math.sqrt)
+tanh_ = _inplace(_math.tanh)
+flatten_ = _inplace(_manip.flatten)
+squeeze_ = _inplace(_manip.squeeze)
+unsqueeze_ = _inplace(_manip.unsqueeze)
+index_add_ = _inplace(_manip.index_add)
+put_along_axis_ = _inplace(_manip.put_along_axis)
+
+
+def zero_(x):
+    """reference: varbase_patch_methods zero_."""
+    x._set_array(jnp.zeros_like(x._array))
+    return x
+
+
+def fill_(x, value):
+    x._set_array(jnp.full_like(x._array, value))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# math / linalg stragglers
+# ---------------------------------------------------------------------------
+
+def erfinv(x, name=None):
+    """reference: tensor/math.py erfinv → phi erfinv kernel."""
+    return apply_op(jax.scipy.special.erfinv, x, op_name="erfinv")
+
+
+erfinv_ = _inplace(erfinv)
+
+
+def mm(input, mat2, name=None):
+    """Alias of matmul (reference: tensor/math.py mm)."""
+    return _linalg.matmul(input, mat2)
+
+
+def inverse(x, name=None):
+    """Alias of linalg.inv (reference: tensor/math.py inverse)."""
+    return _linalg.inv(x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) — reference: tensor/math.py addmm."""
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+        op_name="addmm")
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition (reference: tensor/math.py frexp).
+    Returns (mantissa in ±[0.5, 1), exponent) with zeros mapping to
+    (0, 0)."""
+    def _f(a):
+        af = a.astype(jnp.float32)
+        exp = jnp.where(af == 0, 0,
+                        jnp.floor(jnp.log2(jnp.abs(
+                            jnp.where(af == 0, 1.0, af)))) + 1)
+        mant = af / jnp.exp2(exp)
+        return mant.astype(a.dtype), exp.astype(a.dtype)
+    return apply_op(_f, x, op_name="frexp", n_outs=2)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x,
+        op_name="nanmedian")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.nanquantile(a, q, axis=axis, keepdims=keepdim)
+        .astype(a.dtype), x, op_name="nanquantile")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu results into P, L, U
+    (reference: tensor/linalg.py lu_unpack)."""
+    def _unpack(lu_arr, piv_arr):
+        m, n = lu_arr.shape[-2], lu_arr.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_arr[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_arr.dtype)
+        U = jnp.triu(lu_arr[..., :k, :])
+        # pivots (1-based sequential row swaps) → permutation matrix
+        perm = jnp.broadcast_to(jnp.arange(m),
+                                piv_arr.shape[:-1] + (m,)).copy()
+
+        def apply_swaps(perm_row, piv_row):
+            def body(i, p):
+                j = piv_row[i] - 1
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            return jax.lax.fori_loop(0, piv_row.shape[0], body, perm_row)
+
+        flat_perm = perm.reshape(-1, m)
+        flat_piv = piv_arr.reshape(-1, piv_arr.shape[-1])
+        out = jax.vmap(apply_swaps)(flat_perm, flat_piv)
+        P = jax.nn.one_hot(out, m, dtype=lu_arr.dtype)
+        P = jnp.swapaxes(P, -1, -2).reshape(lu_arr.shape[:-2] + (m, m))
+        return P, L, U
+    return apply_op(_unpack, x, y, op_name="lu_unpack", n_outs=3)
+
+
+# ---------------------------------------------------------------------------
+# manipulation stragglers
+# ---------------------------------------------------------------------------
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-index gather (reference: tensor/math.py take)."""
+    assert mode in ("raise", "wrap", "clip")
+    n_elems = int(np.prod(x.shape)) if isinstance(x, Tensor) \
+        else int(np.asarray(x).size)
+    idx_val = index._array if isinstance(index, Tensor) else index
+    if mode == "raise" and not isinstance(idx_val, jax.core.Tracer):
+        # eager host-side bounds check, matching the reference's error;
+        # under jit the index is a tracer, so fall back to clip semantics
+        idx_np = np.asarray(idx_val)
+        if idx_np.size and (idx_np.min() < -n_elems
+                            or idx_np.max() >= n_elems):
+            raise ValueError(
+                f"take(mode='raise'): index out of range for tensor with "
+                f"{n_elems} elements (got min {idx_np.min()}, "
+                f"max {idx_np.max()})")
+
+    def _f(a, idx):
+        flat = a.reshape(-1)
+        if mode == "raise":
+            idx = jnp.where(idx < 0, idx + n_elems, idx)
+            return jnp.take(flat, idx.reshape(-1),
+                            mode="clip").reshape(idx.shape)
+        return jnp.take(flat, idx.reshape(-1),
+                        mode=mode).reshape(idx.shape)
+    return apply_op(_f, x, index, op_name="take")
+
+
+def vsplit(x, num_or_sections, name=None):
+    assert x.ndim >= 2, "vsplit expects ndim >= 2"
+    return _manip.split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    axis = 0 if x.ndim == 1 else 1
+    return _manip.split(x, num_or_sections, axis=axis)
+
+
+def dsplit(x, num_or_sections, name=None):
+    assert x.ndim >= 3, "dsplit expects ndim >= 3"
+    return _manip.split(x, num_or_sections, axis=2)
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference: fluid.layers.reverse)."""
+    return _manip.flip(x, axis)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    """reference: tensor/manipulation.py strided_slice."""
+    def _f(a):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a[tuple(idx)]
+    return apply_op(_f, x, op_name="strided_slice")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Pure shape math (reference: tensor/manipulation.py
+    broadcast_shape)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---------------------------------------------------------------------------
+# dtype predicates & printing
+# ---------------------------------------------------------------------------
+
+def is_complex(x):
+    return jnp.issubdtype(x._array.dtype if isinstance(x, Tensor)
+                          else jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._array.dtype if isinstance(x, Tensor)
+                          else jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._array.dtype if isinstance(x, Tensor)
+                          else jnp.asarray(x).dtype, jnp.integer)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: tensor/to_string.py set_printoptions — our Tensor repr
+    renders through numpy, so numpy's printoptions are the single knob."""
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    np.set_printoptions(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# TensorArray parity (reference: tensor/array.py — LoDTensorArray ops).
+# Dygraph-mode semantics: a plain python list of Tensors.
+# ---------------------------------------------------------------------------
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = list(initialized_list) if initialized_list else []
+    for v in arr:
+        assert isinstance(v, Tensor), \
+            "create_array initialized_list must hold Tensors"
+    return arr
+
+
+def array_write(x, i, array=None):
+    i = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    if array is None:
+        array = []
+    if i < len(array):
+        array[i] = x
+    else:
+        assert i == len(array), \
+            f"array_write index {i} out of range {len(array)}"
+        array.append(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    return array[i]
+
+
+def array_length(array):
+    return len(array)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference: tensor/creation.py create_tensor."""
+    from ..core.dtype import convert_dtype
+    return Tensor(jnp.zeros([], convert_dtype(dtype)))

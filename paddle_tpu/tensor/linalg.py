@@ -246,7 +246,12 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 def lu(x, pivot=True, get_infos=False, name=None):
     import jax.scipy.linalg as jsl
     x = _ensure_tensor(x)
-    lu_, piv = apply_op(lambda a: tuple(jsl.lu_factor(a)), x, op_name="lu")
+    # paddle returns LAPACK 1-based sequential-swap pivots
+    # (reference: tensor/linalg.py lu); jax's lu_factor is 0-based
+    lu_, piv = apply_op(
+        lambda a: (lambda f: (f[0], (f[1] + 1).astype(jnp.int32)))(
+            jsl.lu_factor(a)),
+        x, op_name="lu")
     if get_infos:
         from .creation import zeros
         return lu_, piv, zeros([1], dtype="int32")

@@ -1,10 +1,11 @@
 """Text datasets (reference: python/paddle/text/datasets/ — Conll05st,
 Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16).
 
-Network download is unavailable (zero-egress); each dataset loads from a
-local `data_file` when given and otherwise produces a deterministic
-synthetic corpus with the same record structure as the real one — the
-hermetic-CI pattern shared with vision.datasets."""
+Network download is unavailable (zero-egress), and real-corpus parsing is
+not implemented: passing `data_file` raises NotImplementedError. Each
+dataset instead produces a deterministic synthetic corpus with the same
+record structure as the real one — the hermetic-CI pattern shared with
+vision.datasets."""
 from __future__ import annotations
 
 import numpy as np
@@ -20,6 +21,12 @@ class _SyntheticTextDataset(Dataset):
     Positional order (data_file, mode) matches the reference datasets."""
 
     def __init__(self, data_file=None, mode="train", seed=0):
+        if data_file is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: loading a real corpus from "
+                f"data_file is not supported in this zero-egress build; "
+                f"omit data_file to use the deterministic synthetic "
+                f"corpus (same record structure).")
         self.mode = mode
         self.data_file = data_file
         self._rng = np.random.RandomState(

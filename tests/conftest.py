@@ -7,6 +7,11 @@ xla_force_host_platform_device_count=8.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: axon may be preset in env
+# Drop the axon TPU-tunnel registration entirely: tests (and every child
+# process they spawn) are CPU-only, and sitecustomize's register() can
+# block indefinitely when the tunnel is down — child processes would hang
+# at interpreter startup, surfacing as _queue.Empty test timeouts.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

