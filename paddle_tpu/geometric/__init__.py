@@ -12,8 +12,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply_op
 from ..ops.registry import register, _ensure_tensor
 
-__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
-           "segment_max", "segment_min"]
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min",
+           "sample_neighbors", "reindex_graph"]
 
 
 def _segment(name, combiner):
@@ -98,3 +99,88 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
             return s / jnp.maximum(c, 1)
         return _REDUCERS[reduce_op](msgs, di, num_segments=n_out)
     return apply_op(_f, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, compute_type="add", name=None):
+    """Per-edge message op(x[src], y[dst]) — [E, ...] output
+    (reference: python/paddle/geometric/message_passing/send_recv.py
+    send_uv over the graph_send_uv phi kernel)."""
+    x, y = _ensure_tensor(x), _ensure_tensor(y)
+    src_index = _ensure_tensor(src_index)
+    dst_index = _ensure_tensor(dst_index)
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    assert compute_type in ops, f"unknown compute_type {compute_type!r}"
+    fn = ops[compute_type]
+
+    def _f(xa, ya, si, di):
+        return fn(xa[si.astype(jnp.int32)], ya[di.astype(jnp.int32)])
+    return apply_op(_f, x, y, src_index, dst_index, op_name="send_uv")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform CSC neighbor sampling — host-side numpy data prep (the
+    reference's kernel is also dynamic-shaped CPU/GPU prep work, not a
+    training-loop op; reference:
+    python/paddle/geometric/sampling/neighbors.py sample_neighbors)."""
+    import numpy as np
+    rown = np.asarray(row._array if isinstance(row, Tensor) else row)
+    colp = np.asarray(colptr._array if isinstance(colptr, Tensor)
+                      else colptr)
+    nodes = np.asarray(input_nodes._array
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    eid_arr = None
+    if eids is not None:
+        eid_arr = np.asarray(eids._array if isinstance(eids, Tensor)
+                             else eids)
+    out_n, out_c, out_e = [], [], []
+    for nd in nodes.reshape(-1):
+        beg, end = int(colp[nd]), int(colp[nd + 1])
+        neigh = rown[beg:end]
+        idx = np.arange(beg, end)
+        if sample_size >= 0 and len(neigh) > sample_size:
+            # global numpy RNG: each epoch resamples a fresh subgraph
+            pick = np.random.choice(len(neigh), size=sample_size,
+                                    replace=False)
+            neigh = neigh[pick]
+            idx = idx[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eid_arr is not None:
+            out_e.append(eid_arr[idx])
+    out_neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_n) if out_n else np.zeros(0, rown.dtype)))
+    out_count = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        assert eid_arr is not None, "return_eids requires eids"
+        out_eids = Tensor(jnp.asarray(
+            np.concatenate(out_e) if out_e else np.zeros(0,
+                                                         eid_arr.dtype)))
+        return out_neighbors, out_count, out_eids
+    return out_neighbors, out_count
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Relabel center nodes + sampled neighbors to contiguous local ids
+    (reference: python/paddle/geometric/reindex.py reindex_graph)."""
+    import numpy as np
+    xa = np.asarray(x._array if isinstance(x, Tensor) else x).reshape(-1)
+    na = np.asarray(neighbors._array if isinstance(neighbors, Tensor)
+                    else neighbors).reshape(-1)
+    ca = np.asarray(count._array if isinstance(count, Tensor)
+                    else count).reshape(-1)
+    # local id order: centers first (in x order), then first-seen neighbors
+    mapping = {}
+    for nd in xa:
+        mapping.setdefault(int(nd), len(mapping))
+    for nd in na:
+        mapping.setdefault(int(nd), len(mapping))
+    out_nodes = np.fromiter(mapping.keys(), dtype=xa.dtype,
+                            count=len(mapping))
+    reindex_src = np.asarray([mapping[int(nd)] for nd in na], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xa), dtype=np.int64), ca)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))

@@ -5,4 +5,6 @@ from .conv import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+from .extended import *  # noqa: F401,F403
 from ...tensor.manipulation import pad  # noqa: F401

@@ -1,0 +1,127 @@
+"""Sequence / margin-loss / beam-search functional ops.
+
+Reference: python/paddle/nn/functional/extension.py (sequence_mask,
+gather_tree, temporal_shift), python/paddle/nn/functional/loss.py
+(margin_cross_entropy — the ArcFace family over the
+c_softmax_with_cross_entropy TP kernel,
+paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu),
+python/paddle/nn/functional/common.py (class_center_sample, kernel
+paddle/phi/kernels/gpu/class_center_sample_kernel.cu).
+
+TPU notes: margin_cross_entropy under GSPMD shards the class axis with a
+PartitionSpec on the logits — XLA inserts the psum the reference's
+collective op does by hand. class_center_sample is host-side data prep
+(dynamic shapes), like the reference's CPU path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply_op
+from ...ops.registry import _ensure_tensor
+
+__all__ = ["sequence_mask", "gather_tree", "class_center_sample",
+           "margin_cross_entropy"]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths → 0/1 mask [.., maxlen]
+    (reference: nn/functional/extension.py sequence_mask)."""
+    x = _ensure_tensor(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._array).max())
+    from ...core.dtype import convert_dtype
+
+    def _f(a):
+        return (jnp.arange(maxlen) < a[..., None]).astype(
+            convert_dtype(dtype))
+    return apply_op(_f, x, op_name="sequence_mask")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace: [T, B, beam] ids + parent indices → full
+    beams (reference: nn/functional/extension.py gather_tree → phi
+    gather_tree kernel). Reverse lax.scan over time."""
+    ids, parents = _ensure_tensor(ids), _ensure_tensor(parents)
+
+    def _f(ids_a, par_a):
+        T, B, K = ids_a.shape
+        binds = jnp.arange(B)[:, None]
+
+        def step(beam_idx, t):
+            # beam_idx [B, K] = which beam each output slot follows at t+1
+            out = ids_a[t][binds, beam_idx]
+            prev = par_a[t][binds, beam_idx]
+            return prev, out
+
+        last = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, outs = lax.scan(step, last, jnp.arange(T), reverse=True)
+        return outs
+    return apply_op(_f, ids, parents, op_name="gather_tree")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers (PartialFC): keeps every positive class and
+    pads with negatives to `num_samples`; returns (remapped_label,
+    sampled_class_center). Host-side numpy — dynamic-shaped data prep
+    (reference: nn/functional/common.py class_center_sample)."""
+    lab = np.asarray(label._array if isinstance(label, Tensor) else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        # global numpy RNG: fresh negatives each call, seedable via
+        # np.random.seed for reproducible runs
+        extra = np.random.choice(rest, size=num_samples - len(pos),
+                                 replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax CE: target cos θ becomes
+    cos(m1·θ + m2) − m3, all logits scaled by `scale`
+    (reference: nn/functional/loss.py margin_cross_entropy over the
+    c_softmax_with_cross_entropy TP kernel)."""
+    logits, label = _ensure_tensor(logits), _ensure_tensor(label)
+
+    def _f(lg, lb):
+        lb = lb.reshape(-1).astype(jnp.int32)
+        one_hot = jax.nn.one_hot(lb, lg.shape[-1], dtype=lg.dtype)
+        # epsilon keeps arccos' (infinite slope at ±1) off the clip
+        # boundary — at exactly ±1 the 0·inf product would NaN the grads
+        eps = 1e-6
+        cos = jnp.clip(lg, -1.0 + eps, 1.0 - eps)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = jnp.where(one_hot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.sum(one_hot * logp, axis=-1)
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss
+        if return_softmax:
+            return loss_out, jnp.exp(logp)
+        return loss_out
+
+    if return_softmax:
+        return apply_op(_f, logits, label, op_name="margin_cross_entropy",
+                        n_outs=2)
+    return apply_op(_f, logits, label, op_name="margin_cross_entropy")
+
+
+from ...ops.registry import register as _register  # noqa: E402
+for _n in __all__:
+    _register(_n, globals()[_n])

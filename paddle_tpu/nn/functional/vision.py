@@ -1,0 +1,148 @@
+"""Vision-related functional ops (reference:
+python/paddle/nn/functional/vision.py — affine_grid, grid_sample,
+pixel_shuffle...; CUDA kernels at paddle/phi/kernels/gpu/grid_sample_*).
+
+grid_sample is pure gather + lerp — XLA lowers it to dynamic-gathers that
+vectorize on the VPU; all shapes static, no data-dependent control flow.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import apply_op
+from ...ops.registry import _ensure_tensor
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] → sampling grid [N, H, W, 2]
+    (reference: nn/functional/vision.py affine_grid)."""
+    theta = _ensure_tensor(theta)
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def _f(th):
+        def axis_coords(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+        ys = axis_coords(H)
+        xs = axis_coords(W)
+        gx, gy = jnp.meshgrid(xs, ys)            # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        # [N,2,3] x [H,W,3] → [N,H,W,2]
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return apply_op(_f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N,C,H,W], grid [N,Hg,Wg,2] (xy in [-1,1]) → [N,C,Hg,Wg]
+    (reference: nn/functional/vision.py grid_sample)."""
+    assert mode in ("bilinear", "nearest")
+    assert padding_mode in ("zeros", "border", "reflection")
+    x, grid = _ensure_tensor(x), _ensure_tensor(grid)
+
+    def _unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def _reflect(coord, low, high):
+        # reflect into [low, high] (continuous reflection padding);
+        # a size-1 dim has span 0 — mod-by-zero would NaN, so clamp
+        span = high - low
+        if span <= 0:
+            return jnp.full_like(coord, low)
+        coord = jnp.abs((coord - low) % (2 * span) - span) + low
+        return coord
+
+    def _f(xa, ga):
+        N, C, H, W = xa.shape
+        gx = _unnormalize(ga[..., 0], W)          # [N,Hg,Wg]
+        gy = _unnormalize(ga[..., 1], H)
+        if padding_mode == "border":
+            gx = jnp.clip(gx, 0, W - 1)
+            gy = jnp.clip(gy, 0, H - 1)
+        elif padding_mode == "reflection":
+            if align_corners:
+                gx = _reflect(gx, 0.0, W - 1.0)
+                gy = _reflect(gy, 0.0, H - 1.0)
+            else:
+                gx = jnp.clip(_reflect(gx, -0.5, W - 0.5), 0, W - 1)
+                gy = jnp.clip(_reflect(gy, -0.5, H - 0.5), 0, H - 1)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, H - 1)
+            ixc = jnp.clip(ix, 0, W - 1)
+            # vals [N, C, Hg, Wg]
+            vals = jnp.take_along_axis(
+                xa.reshape(N, C, H * W),
+                (iyc * W + ixc).reshape(N, 1, -1).astype(jnp.int32)
+                .repeat(C, axis=1),
+                axis=2).reshape(N, C, *iy.shape[1:])
+            if padding_mode == "zeros":
+                valid = ((iy >= 0) & (iy < H) & (ix >= 0)
+                         & (ix < W))[:, None]
+                vals = jnp.where(valid, vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(gy).astype(jnp.int32),
+                          jnp.round(gx).astype(jnp.int32))
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x0i + 1)
+        v10 = gather(y0i + 1, x0i)
+        v11 = gather(y0i + 1, x0i + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+    return apply_op(_f, x, grid, op_name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM channel shift along the segment (time) axis
+    (reference: nn/functional/vision.py temporal_shift → phi
+    temporal_shift kernel)."""
+    assert data_format in ("NCHW", "NHWC")
+    if not 0.0 <= shift_ratio <= 0.5:
+        raise ValueError(
+            f"temporal_shift: shift_ratio must be in [0, 0.5], got "
+            f"{shift_ratio} (the two shifted blocks may not overlap)")
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        NT, C, H, W = a.shape
+        T = seg_num
+        N = NT // T
+        a = a.reshape(N, T, C, H, W)
+        fold = int(C * shift_ratio)
+        left = jnp.concatenate(
+            [a[:, 1:, :fold], jnp.zeros_like(a[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, fold:2 * fold]),
+             a[:, :-1, fold:2 * fold]], axis=1)
+        mid = a[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, mid], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return apply_op(_f, x, op_name="temporal_shift")
+
+
+from ...ops.registry import register as _register  # noqa: E402
+for _n in __all__:
+    _register(_n, globals()[_n])

@@ -1,0 +1,164 @@
+"""Tests for nn.functional vision/extended ops + geometric sampling."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import geometric as G
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_affine_grid_identity_and_shift():
+    theta = _t(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 3, 3])
+    assert tuple(grid.shape) == (1, 3, 3, 2)
+    # corners at +-1 with align_corners=True
+    g = grid.numpy()
+    np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+
+
+def test_grid_sample_identity_and_modes():
+    x = _t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    theta = _t(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = F.grid_sample(x, grid)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+    out_n = F.grid_sample(x, grid, mode="nearest")
+    np.testing.assert_allclose(out_n.numpy(), x.numpy(), atol=1e-5)
+    # translation by a full cell with zeros padding pulls in zeros
+    theta2 = _t(np.array([[[1, 0, 2.0], [0, 1, 0]]], np.float32))
+    grid2 = F.affine_grid(theta2, [1, 1, 4, 4])
+    out2 = F.grid_sample(x, grid2, padding_mode="zeros")
+    assert float(np.abs(out2.numpy()[..., -1]).sum()) == 0.0
+    for pm in ("border", "reflection"):
+        outp = F.grid_sample(x, grid2, padding_mode=pm)
+        assert np.isfinite(outp.numpy()).all()
+
+
+def test_grid_sample_gradient():
+    x = _t(np.random.default_rng(0).standard_normal((1, 2, 4, 4))
+           .astype(np.float32))
+    x.stop_gradient = False
+    theta = _t(np.array([[[0.9, 0, 0.1], [0, 0.9, -0.1]]], np.float32))
+    grid = F.affine_grid(theta, [1, 2, 4, 4])
+    out = F.grid_sample(x, grid)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_temporal_shift_moves_channels():
+    NT, C, H, W = 4, 8, 2, 2
+    x = np.random.default_rng(1).standard_normal((NT, C, H, W)) \
+        .astype(np.float32)
+    out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+    xr = x.reshape(2, 2, C, H, W)
+    fold = 2
+    # left-shift block: out[t] = x[t+1], last zero
+    np.testing.assert_allclose(out.reshape(2, 2, C, H, W)[:, 0, :fold],
+                               xr[:, 1, :fold])
+    assert np.abs(out.reshape(2, 2, C, H, W)[:, 1, :fold]).sum() == 0
+    # untouched block passes through
+    np.testing.assert_allclose(out.reshape(2, 2, C, H, W)[..., 2 * fold:,
+                                                          :, :],
+                               xr[..., 2 * fold:, :, :])
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(_t(np.array([1, 3], np.int64)), maxlen=4,
+                        dtype="float32")
+    np.testing.assert_allclose(m.numpy(),
+                               [[1, 0, 0, 0], [1, 1, 1, 0]])
+    # maxlen inferred from data
+    m2 = F.sequence_mask(_t(np.array([2, 3], np.int64)))
+    assert tuple(m2.shape) == (2, 3)
+
+
+def test_gather_tree_backtrace():
+    ids = _t(np.array([[[2, 2]], [[3, 4]], [[5, 6]]], np.int64))
+    par = _t(np.array([[[0, 0]], [[1, 0]], [[1, 0]]], np.int64))
+    out = F.gather_tree(ids, par).numpy()
+    # beam 0 path: 5 <- parent 1 -> ids[1][1]=4 <- parent 0 -> ids[0][0]=2
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [2, 3, 6])
+
+
+def test_margin_cross_entropy_reduces_to_ce():
+    rng = np.random.default_rng(2)
+    logits = rng.uniform(-1, 1, (8, 12)).astype(np.float32)
+    label = rng.integers(0, 12, (8,)).astype(np.int64)
+    # no margins, scale 1 → plain softmax CE on the raw cos logits
+    loss = F.margin_cross_entropy(_t(logits), _t(label), margin1=1.0,
+                                  margin2=0.0, margin3=0.0, scale=1.0)
+    z = logits - logits.max(-1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    ref = -logp[np.arange(8), label].mean()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+    # with margin, target class logit shrinks → loss grows
+    loss_m = F.margin_cross_entropy(_t(logits), _t(label), margin2=0.5,
+                                    scale=1.0)
+    assert float(loss_m.numpy()) > float(loss.numpy())
+
+
+def test_margin_cross_entropy_saturated_logits_finite_grad():
+    # regression: |logit| >= 1 hits the arccos clip boundary; grads must
+    # stay finite (0·inf NaN without the epsilon clip)
+    logits = _t(np.array([[1.5, -2.0, 0.3], [1.0, -1.0, 0.0]], np.float32))
+    logits.stop_gradient = False
+    label = _t(np.array([0, 1], np.int64))
+    loss = F.margin_cross_entropy(logits, label, margin2=0.3)
+    loss.backward()
+    assert np.isfinite(logits.grad.numpy()).all()
+
+
+def test_class_center_sample():
+    label = _t(np.array([3, 7, 3, 9], np.int64))
+    remapped, sampled = F.class_center_sample(label, 20, 6)
+    s = sampled.numpy()
+    assert len(s) == 6 and len(set(s.tolist())) == 6
+    assert {3, 7, 9}.issubset(set(s.tolist()))
+    r = remapped.numpy()
+    for orig, rm in zip([3, 7, 3, 9], r):
+        assert s[rm] == orig
+
+
+def test_send_uv():
+    x = _t(np.arange(6, dtype=np.float32).reshape(3, 2))
+    y = _t(np.ones((3, 2), np.float32))
+    src = _t(np.array([0, 2], np.int64))
+    dst = _t(np.array([1, 0], np.int64))
+    out = G.send_uv(x, y, src, dst, "mul").numpy()
+    np.testing.assert_allclose(out, x.numpy()[[0, 2]])
+    out = G.send_uv(x, y, src, dst, "add").numpy()
+    np.testing.assert_allclose(out, x.numpy()[[0, 2]] + 1)
+
+
+def test_sample_neighbors_and_reindex():
+    # graph in CSC: node0 <- {1,2}, node1 <- {0,2}, node2 <- {0,1}
+    row = _t(np.array([1, 2, 0, 2, 0, 1], np.int64))
+    colptr = _t(np.array([0, 2, 4, 6], np.int64))
+    nodes = _t(np.array([0, 2], np.int64))
+    nb, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=-1)
+    np.testing.assert_array_equal(cnt.numpy(), [2, 2])
+    np.testing.assert_array_equal(nb.numpy(), [1, 2, 0, 1])
+    # capped sampling
+    nb1, cnt1 = G.sample_neighbors(row, colptr, nodes, sample_size=1)
+    np.testing.assert_array_equal(cnt1.numpy(), [1, 1])
+    # eids
+    eids = _t(np.arange(6, dtype=np.int64))
+    nb2, cnt2, eid2 = G.sample_neighbors(row, colptr, nodes,
+                                         sample_size=-1, eids=eids,
+                                         return_eids=True)
+    np.testing.assert_array_equal(eid2.numpy(), [0, 1, 4, 5])
+    # reindex: centers get ids 0..len(x)-1, neighbors follow
+    rs, rd, out_nodes = G.reindex_graph(nodes, nb, cnt)
+    assert out_nodes.numpy()[0] == 0 and out_nodes.numpy()[1] == 2
+    np.testing.assert_array_equal(rd.numpy(), [0, 0, 1, 1])
+    # every src id maps back to the original neighbor node
+    for local, orig in zip(rs.numpy(), nb.numpy()):
+        assert out_nodes.numpy()[local] == orig
