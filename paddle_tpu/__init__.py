@@ -41,7 +41,11 @@ from . import distributed  # noqa: E402
 from . import vision  # noqa: E402
 from . import metric  # noqa: E402
 from . import framework  # noqa: E402
-from . import linalg  # noqa: E402
+# `from .tensor import *` above re-exported the tensor.linalg submodule
+# under the name `linalg`, which `from . import linalg` would silently
+# reuse — import the real namespace module explicitly instead
+import importlib as _importlib  # noqa: E402
+linalg = _importlib.import_module(".linalg", __name__)
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402

@@ -2,3 +2,4 @@
 from . import nn
 from . import autograd
 from . import asp
+from . import optimizer

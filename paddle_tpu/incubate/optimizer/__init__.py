@@ -1,0 +1,9 @@
+"""paddle.incubate.optimizer parity (reference:
+python/paddle/incubate/optimizer/ — lookahead.py, modelaverage.py,
+lbfgs.py, distributed_fused_lamb.py)."""
+from .lookahead import LookAhead
+from .modelaverage import ModelAverage
+from ...optimizer.lbfgs import LBFGS  # noqa: F401 — same implementation
+from .distributed_fused_lamb import DistributedFusedLamb
+
+__all__ = ["LookAhead", "ModelAverage", "LBFGS", "DistributedFusedLamb"]

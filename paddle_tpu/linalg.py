@@ -6,3 +6,5 @@ from .tensor.linalg import (matmul, bmm, dot, mv, t, norm, dist, cond, cross,
                             pinv, lstsq, lu, multi_dot, corrcoef, cov,
                             householder_product)
 from .tensor.math import trace
+
+from .tensor.extras import lu_unpack  # noqa: E402,F401

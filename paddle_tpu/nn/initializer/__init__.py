@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from ...framework.random import next_key
 
 __all__ = [
+    "Bilinear",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
@@ -212,3 +213,24 @@ def _resolve_initializer(attr, default_initializer=None, is_bias=False):
     if g is not None:
         return g
     return Constant(0.0) if is_bias else XavierUniform()
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv weights
+    [C_out, C_in, K, K] — every (out, in) filter gets the kernel, as in
+    the reference (python/paddle/nn/initializer/Bilinear over
+    fluid/initializer.py BilinearInitializer; typical use is
+    Conv2DTranspose with groups=C and weight [C, 1, K, K])."""
+
+    def __call__(self, shape, dtype):
+        assert len(shape) == 4, "Bilinear expects a 4-D conv weight"
+        k = shape[-1]
+        assert shape[-2] == k, "Bilinear expects square kernels"
+        # Caffe/paddle formula: f = ceil(k/2), c = (2f - 1 - f%2) / (2f),
+        # w[i] = 1 - |i/f - c|
+        f = (k + 1) // 2
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = jnp.arange(k, dtype=jnp.float32)
+        filt = 1.0 - jnp.abs(og / f - c)
+        kernel2d = filt[:, None] * filt[None, :]
+        return jnp.broadcast_to(kernel2d, shape).astype(dtype)

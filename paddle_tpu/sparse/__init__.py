@@ -18,7 +18,8 @@ from ..core.tensor import Tensor
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "is_same_shape", "add", "subtract", "multiply", "divide",
            "matmul", "relu", "tanh", "sqrt", "sin", "abs", "pow", "neg",
-           "cast", "transpose", "sum"]
+           "cast", "transpose", "sum", "coalesce", "mask_as",
+           "masked_matmul", "mv", "addmm", "reshape", "nn"]
 
 
 class SparseCooTensor(Tensor):
@@ -150,3 +151,96 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (reference: python/paddle/sparse/unary.py
+    coalesce → phi sparse coalesce kernel)."""
+    assert isinstance(x, SparseCooTensor)
+    b = jsparse.bcoo_sum_duplicates(x._bcoo)
+    return SparseCooTensor(b, stop_gradient=x.stop_gradient)
+
+
+def mask_as(x, mask, name=None):
+    """Keep only the entries of dense `x` at `mask`'s sparsity pattern
+    (reference: python/paddle/sparse/unary.py mask_as /
+    sparse_mask)."""
+    assert isinstance(mask, SparseCooTensor)
+    xd = x._array if isinstance(x, Tensor) else jnp.asarray(x)
+    b = mask._bcoo
+    idx = tuple(b.indices[:, d] for d in range(b.indices.shape[1]))
+    vals = xd[idx]
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape),
+                           stop_gradient=getattr(x, "stop_gradient", True))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's pattern — SDDMM
+    (reference: python/paddle/sparse/binary.py masked_matmul)."""
+    xd = x._array if isinstance(x, Tensor) else jnp.asarray(x)
+    yd = y._array if isinstance(y, Tensor) else jnp.asarray(y)
+    return mask_as(Tensor(jnp.matmul(xd, yd)), mask)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector
+    (reference: python/paddle/sparse/binary.py mv)."""
+    assert isinstance(x, SparseCooTensor)
+    v = vec._array if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(x._bcoo @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta*input + alpha*(x @ y) with sparse x
+    (reference: python/paddle/sparse/binary.py addmm)."""
+    inp = input.to_dense()._array if isinstance(input, SparseCooTensor) \
+        else (input._array if isinstance(input, Tensor)
+              else jnp.asarray(input))
+    prod = matmul(x, y)._array
+    return Tensor(beta * inp + alpha * prod)
+
+
+def reshape(x, shape, name=None):
+    """reference: python/paddle/sparse/unary.py reshape."""
+    if isinstance(x, SparseCooTensor):
+        b = jsparse.bcoo_reshape(x._bcoo,
+                                 new_sizes=tuple(int(s) for s in shape))
+        return SparseCooTensor(b, stop_gradient=x.stop_gradient)
+    return Tensor(jnp.reshape(x._array, shape))
+
+
+class _SparseReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _SparseSoftmax:
+    """Softmax over the STORED entries of each row (the sparsity pattern
+    comes from the indices, so explicitly-stored zeros participate —
+    reference: python/paddle/sparse/nn/layer/activation.py Softmax)."""
+
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        import jax
+        if isinstance(x, SparseCooTensor):
+            b = jsparse.bcoo_sum_duplicates(x._bcoo)
+            pattern = jnp.zeros(b.shape, bool).at[
+                tuple(b.indices[:, d] for d in range(b.indices.shape[1]))
+            ].set(True)
+            d = b.todense()
+            neg_inf = jnp.where(pattern, d, -jnp.inf)
+            sm = jax.nn.softmax(neg_inf, axis=self.axis)
+            vals = sm[tuple(b.indices[:, d2]
+                            for d2 in range(b.indices.shape[1]))]
+            return SparseCooTensor(
+                jsparse.BCOO((vals, b.indices), shape=b.shape),
+                stop_gradient=x.stop_gradient)
+        import jax.nn
+        return Tensor(jax.nn.softmax(x._array, axis=self.axis))
+
+
+import types as _types  # noqa: E402
+
+nn = _types.SimpleNamespace(ReLU=_SparseReLU, Softmax=_SparseSoftmax)

@@ -1,0 +1,158 @@
+"""Sparse completions, incubate.optimizer, Bilinear init, linalg ns."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import sparse as sp
+
+
+def test_sparse_coalesce_merges_duplicates():
+    t = sp.sparse_coo_tensor([[0, 0, 1], [1, 1, 2]], [1.0, 2.0, 3.0],
+                             (2, 3))
+    c = sp.coalesce(t)
+    dense = c.to_dense().numpy()
+    assert dense[0, 1] == 3.0 and dense[1, 2] == 3.0
+
+
+def test_sparse_mask_as_and_masked_matmul():
+    mask = sp.sparse_coo_tensor([[0, 1], [0, 2]], [1.0, 1.0], (2, 3))
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    m = sp.mask_as(x, mask)
+    np.testing.assert_allclose(m.values().numpy(), [0.0, 5.0])
+    a = paddle.to_tensor(np.ones((2, 4), np.float32))
+    b = paddle.to_tensor(np.ones((4, 3), np.float32))
+    sd = sp.masked_matmul(a, b, mask)
+    np.testing.assert_allclose(sd.values().numpy(), [4.0, 4.0])
+    # zero positions stay zero
+    assert sd.to_dense().numpy()[0, 1] == 0.0
+
+
+def test_sparse_mv_addmm_reshape():
+    t = sp.sparse_coo_tensor([[0, 1], [1, 0]], [2.0, 3.0], (2, 2))
+    v = paddle.to_tensor(np.array([1.0, 10.0], np.float32))
+    np.testing.assert_allclose(sp.mv(t, v).numpy(), [20.0, 3.0])
+    inp = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    out = sp.addmm(inp, t, y, beta=2.0, alpha=1.0)
+    np.testing.assert_allclose(out.numpy(), 2.0 + t.to_dense().numpy())
+    r = sp.reshape(t, [4, 1])
+    assert tuple(r.shape) == (4, 1)
+    np.testing.assert_allclose(r.to_dense().numpy().reshape(-1),
+                               t.to_dense().numpy().reshape(-1))
+
+
+def test_sparse_nn_layers():
+    t = sp.sparse_coo_tensor([[0, 0], [0, 1]], [-1.0, 2.0], (1, 3))
+    relu_out = sp.nn.ReLU()(t)
+    np.testing.assert_allclose(relu_out.values().numpy(), [0.0, 2.0])
+    sm = sp.nn.Softmax()(t)
+    vals = sm.values().numpy()
+    np.testing.assert_allclose(vals.sum(), 1.0, rtol=1e-6)
+    # stored zeros participate in the softmax (pattern-based, not
+    # value-based): softmax([0, 2]) over the stored entries
+    sm2 = sp.nn.Softmax()(relu_out)
+    np.testing.assert_allclose(sm2.values().numpy(),
+                               np.exp([0.0, 2.0]) / np.exp([0.0, 2.0])
+                               .sum(), rtol=1e-6)
+
+
+def test_bilinear_fills_all_filters_and_odd_kernel():
+    w = np.asarray(paddle.nn.initializer.Bilinear()((3, 1, 4, 4),
+                                                    "float32"))
+    # every (out, in) filter carries the kernel (grouped-conv usage)
+    for c in range(3):
+        assert w[c, 0].sum() > 0
+    np.testing.assert_allclose(w[0, 0], w[2, 0])
+    # odd kernel follows the caffe/paddle formula: f=2, c=0.75 →
+    # filt = [0.25, 0.75, 0.75]
+    w3 = np.asarray(paddle.nn.initializer.Bilinear()((1, 1, 3, 3),
+                                                     "float32"))
+    filt = np.array([0.25, 0.75, 0.75], np.float32)
+    np.testing.assert_allclose(w3[0, 0], filt[:, None] * filt[None, :],
+                               rtol=1e-6)
+
+
+def test_fused_lamb_gradient_accumulation():
+    net = nn.Linear(4, 2)
+    opt = paddle.incubate.optimizer.DistributedFusedLamb(
+        learning_rate=0.1, parameters=net.parameters(),
+        gradient_accumulation_steps=2)
+    w0 = net.weight.numpy().copy()
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    opt.step()  # micro-step 1: accumulate only
+    np.testing.assert_allclose(net.weight.numpy(), w0)
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    opt.step()  # micro-step 2: applies the update
+    assert not np.allclose(net.weight.numpy(), w0)
+
+
+def test_lookahead_interpolates_to_slow_weights():
+    net = nn.Linear(4, 2)
+    w0 = net.weight.numpy().copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    la = paddle.incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+    for _ in range(2):
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    # after k steps weights = slow(0) + alpha*(fast - slow) = alpha*fast
+    # (slow initialized to zero in the reference)
+    assert not np.allclose(net.weight.numpy(), w0)
+
+
+def test_modelaverage_apply_restore():
+    net = nn.Linear(3, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.3,
+                               parameters=net.parameters())
+    ma = paddle.incubate.optimizer.ModelAverage(
+        0.15, parameters=net.parameters())
+    snapshots = []
+    for _ in range(3):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        snapshots.append(net.weight.numpy().copy())
+    current = net.weight.numpy().copy()
+    with ma.apply():
+        avg = net.weight.numpy().copy()
+    np.testing.assert_allclose(avg, np.mean(snapshots, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(net.weight.numpy(), current)
+
+
+def test_distributed_fused_lamb_trains():
+    net = nn.Linear(4, 2)
+    opt = paddle.incubate.optimizer.DistributedFusedLamb(
+        learning_rate=0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    l0 = None
+    for _ in range(5):
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 if l0 is not None else float(loss.numpy())
+    assert float(loss.numpy()) < l0
+
+
+def test_bilinear_initializer():
+    init = paddle.nn.initializer.Bilinear()
+    w = init((2, 2, 4, 4), "float32")
+    # separable bilinear kernel, symmetric for even k
+    k = np.asarray(w)[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)
+
+
+def test_linalg_namespace_complete():
+    for name in ["cholesky", "svd", "qr", "lu", "lu_unpack", "pinv",
+                 "lstsq", "matrix_power", "householder_product"]:
+        assert hasattr(paddle.linalg, name), name
