@@ -7,6 +7,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -42,51 +43,80 @@ def main():
         "tpu" in getattr(dev, "device_kind", "").lower()
 
     if on_tpu:
-        # ~0.95B params: fits one v5e chip (16G HBM) with Adam state
-        cfg = LlamaConfig(
+        # ~0.95B params: fits one v5e chip (16G HBM) with Adam state.
+        # remat-policy ladder: "dots" keeps matmul outputs (backward does
+        # no matmul recompute — fastest) but costs the most HBM; fall back
+        # to full remat, then a smaller batch, if it doesn't fit.
+        variants = [("dots", 4), ("full", 4), ("full", 2)]
+        base = dict(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=2048,
             dtype=jnp.bfloat16, use_remat=True)
-        B, S, iters = 4, 2048, 10
+        S, iters = 2048, 10
     else:  # CPU smoke config
-        cfg = LlamaConfig(
+        variants = [("full", 2)]
+        base = dict(
             vocab_size=1024, hidden_size=256, intermediate_size=512,
             num_hidden_layers=4, num_attention_heads=4,
             num_key_value_heads=4, max_position_embeddings=512,
             dtype=jnp.float32, use_remat=False)
-        B, S, iters = 2, 256, 3
+        S, iters = 256, 3
 
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    opt = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
-    opt_state = opt.init(params)
+    def run_variant(policy, B):
+        cfg = LlamaConfig(remat_policy=policy, **base)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+        opt_state = opt.init(params)
 
-    @jax.jit
-    def step(params, opt_state, batch):
-        (total, ce), grads = jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, batch), has_aux=True)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, ce
+        # donate params + opt_state: the update aliases into the same HBM
+        # buffers instead of allocating a second copy of every tensor
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, batch):
+            (total, ce), grads = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, batch), has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, ce
 
-    rng = np.random.default_rng(0)
-    batch = {
-        "input_ids": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
-        "labels": jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
-    }
+        rng = np.random.default_rng(0)
+        batch = {
+            "input_ids": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+            "labels": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+        }
 
-    # compile + warmup; scalar readback (not block_until_ready) because the
-    # axon tunnel's block_until_ready does not reliably fence execution
-    params, opt_state, ce = step(params, opt_state, batch)
-    float(ce)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
+        # compile + warmup; scalar readback (not block_until_ready)
+        # because the axon tunnel's block_until_ready does not reliably
+        # fence execution
         params, opt_state, ce = step(params, opt_state, batch)
-    float(ce)
-    dt = (time.perf_counter() - t0) / iters
+        float(ce)
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, ce = step(params, opt_state, batch)
+        float(ce)
+        dt = (time.perf_counter() - t0) / iters
+        return cfg, params, dt, B
+
+    last_err = None
+    for policy, B in variants:
+        try:
+            cfg, params, dt, B = run_variant(policy, B)
+            break
+        except Exception as e:  # OOM → next rung of the ladder
+            if "RESOURCE_EXHAUSTED" not in str(e) and \
+                    "Out of memory" not in str(e):
+                raise
+            # keep only the message: the traceback would pin the failed
+            # variant's multi-GB locals in HBM while the next rung runs
+            last_err = RuntimeError(str(e))
+            del e
+            import gc
+            gc.collect()
+    else:
+        raise last_err
 
     n_params = sum(int(np.prod(a.shape))
                    for a in jax.tree_util.tree_leaves(params))
@@ -108,6 +138,7 @@ def main():
             "n_params": n_params,
             "device": getattr(dev, "device_kind", str(dev)),
             "batch": B, "seq": S,
+            "remat_policy": cfg.remat_policy if cfg.use_remat else "none",
         },
     }
     print(json.dumps(result))

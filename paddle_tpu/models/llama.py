@@ -60,6 +60,15 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     # training
     use_remat: bool = True
+    # remat policy: "full" recomputes everything (min memory);
+    # "dots" saves matmul outputs and recomputes only elementwise chains
+    # (near-zero extra FLOPs — the right default when activations fit)
+    remat_policy: str = "dots"
+
+    def __post_init__(self):
+        assert self.remat_policy in ("full", "dots"), \
+            f"remat_policy must be 'full' or 'dots', got " \
+            f"{self.remat_policy!r}"
 
     @property
     def head_dim(self):
@@ -259,7 +268,11 @@ def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos):
         h, aux = carry
         fn = decoder_layer
         if cfg.use_remat:
-            fn = jax.checkpoint(decoder_layer, static_argnums=(0,))
+            policy = None  # "full": save nothing, recompute the layer
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_saveable
+            fn = jax.checkpoint(decoder_layer, static_argnums=(0,),
+                                policy=policy)
         h, a = fn(cfg, lp, h, sin, cos)
         return (h, aux + a), None
     (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)), stacked)
@@ -283,9 +296,12 @@ def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None):
 def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None):
     ids, labels = batch["input_ids"], batch["labels"]
     logits, aux = forward_pure(cfg, params, ids, sp_axis)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    ce = -jnp.mean(ll)
+    # logsumexp form: ce = lse - target_logit. Avoids materializing the
+    # full [B, S, V] log-softmax (1 GB fp32 at bench shapes) — XLA fuses
+    # the reduction into the lm_head matmul epilogue.
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    ce = jnp.mean(lse - tgt)
     return ce + 0.01 * aux, ce
 
 
