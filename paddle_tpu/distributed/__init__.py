@@ -6,9 +6,11 @@ shard_map/pjit; fleet 4-D hybrid topology → one jax Mesh.
 """
 from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
                          all_gather, all_gather_object, broadcast, reduce,
-                         scatter, alltoall, all_to_all, send, recv,
-                         reduce_scatter, barrier, get_rank, get_world_size,
-                         is_initialized, destroy_process_group, wait, stream)
+                         scatter, alltoall, all_to_all, alltoall_single,
+                         send, recv, isend, irecv, reduce_scatter, barrier,
+                         get_rank, get_world_size, get_backend,
+                         is_initialized, destroy_process_group, wait,
+                         stream)
 from .parallel import (init_parallel_env, ParallelEnv, DataParallel)
 from .mesh import (HybridTopology, init_mesh, get_mesh, set_mesh,
                    get_topology, ProcessMesh, PartitionSpec, NamedSharding)
@@ -27,7 +29,9 @@ from .launch_utils import spawn, launch
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
     "all_gather", "all_gather_object", "broadcast", "reduce", "scatter",
-    "alltoall", "all_to_all", "send", "recv", "reduce_scatter", "barrier",
+    "alltoall", "all_to_all", "alltoall_single", "send", "recv", "isend",
+    "irecv", "reduce_scatter", "barrier", "get_backend",
+    "gloo_init_parallel_env", "shutdown_process_group", "split",
     "get_rank", "get_world_size", "is_initialized", "destroy_process_group",
     "wait", "stream", "init_parallel_env", "ParallelEnv", "DataParallel",
     "HybridTopology", "init_mesh", "get_mesh", "set_mesh", "get_topology",
@@ -37,3 +41,38 @@ __all__ = [
     "model_parallel_random_seed", "fleet", "sharding", "spawn", "launch",
     "recompute", "recompute_sequential", "pipeline", "rpc", "auto_parallel",
 ]
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: parallel.py gloo_init_parallel_env — CPU-only bootstrap;
+    the XLA build has one bootstrap path (init_parallel_env)."""
+    return init_parallel_env()
+
+
+def shutdown_process_group(group=None):
+    """reference: collective shutdown_process_group."""
+    return destroy_process_group(group)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: fleet/layers/mpu/mp_ops.py split:653 — builds a
+    row/column-parallel linear or vocab-parallel embedding. Delegates to
+    the TP layer library (fleet mp_layers)."""
+    from . import fleet as _fleet
+    if operation == "linear":
+        if axis == 1:
+            layer = _fleet.ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out)
+        else:
+            layer = _fleet.RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        layer = _fleet.VocabParallelEmbedding(size[0], size[1],
+                                              weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")

@@ -305,3 +305,57 @@ class stream:
     reduce_scatter = staticmethod(reduce_scatter)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all: leading dim split across ranks
+    (reference: python/paddle/distributed/communication/all_to_all.py
+    alltoall_single). Equal splits only — unequal splits have no static
+    shape and do not map to XLA collectives."""
+    assert in_split_sizes is None and out_split_sizes is None, \
+        "alltoall_single: only equal splits are supported on XLA " \
+        "(unequal splits are not static-shape compatible)"
+    axis = _axis_of(group)
+
+    def _f(a):
+        if not _in_trace(a):
+            return a
+        return lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    out = apply_op(_f, in_tensor, op_name="alltoall_single")
+    if out_tensor is not None:
+        out_tensor._set_array(out._array)
+        return out_tensor
+    return out
+
+
+class _CompletedTask:
+    """Future-like handle for the isend/irecv API (XLA collectives are
+    scheduled by the compiler; by the time python sees the result it is
+    already ordered — reference: communication/batch_isend_irecv.py
+    P2POp task semantics)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    """reference: communication/send.py isend — returns a task."""
+    send(tensor, dst, group)
+    return _CompletedTask()
+
+
+def irecv(tensor, src=0, group=None):
+    """reference: communication/recv.py irecv."""
+    recv(tensor, src, group)
+    return _CompletedTask()
+
+
+def get_backend(group=None):
+    """reference: collective.py get_backend — the one backend here is XLA
+    collectives over ICI/DCN."""
+    return "XCCL"

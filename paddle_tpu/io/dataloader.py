@@ -245,3 +245,20 @@ class DataLoader:
             if shm is not None:
                 shm.close()
                 shm.free()
+
+
+def default_convert_fn(batch):
+    """Convert without batching — the DataLoader's collate when
+    batch_size=None (reference: fluid/dataloader/collate.py
+    default_convert_fn)."""
+    import numpy as _np
+    from ..core.tensor import Tensor as _T
+    if isinstance(batch, _T):
+        return batch
+    if isinstance(batch, _np.ndarray):
+        return _T(jnp.asarray(batch))
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(default_convert_fn(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    return batch
