@@ -35,6 +35,8 @@ def _peak_flops(dev) -> float:
 
 
 def main():
+    """Measure and return the result dict (raises on total failure; run()
+    wraps that into an error JSON line)."""
     from paddle_tpu.models.llama import LlamaConfig, init_params, loss_fn
     import optax
 
@@ -100,22 +102,40 @@ def main():
         dt = (time.perf_counter() - t0) / iters
         return cfg, params, dt, B
 
+    def _is_oom(e):
+        return "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+
     last_err = None
+    done = False
     for policy, B in variants:
-        try:
-            cfg, params, dt, B = run_variant(policy, B)
+        attempts = 2  # second attempt: flash kernels disabled
+        for _ in range(attempts):
+            try:
+                cfg, params, dt, B = run_variant(policy, B)
+                done = True
+                break
+            except Exception as e:
+                # keep only the message: the traceback would pin the failed
+                # variant's multi-GB locals in HBM while the next rung runs
+                last_err = RuntimeError(str(e)[-2000:])
+                was_oom = _is_oom(e)
+                del e
+                import gc
+                gc.collect()
+                if was_oom:
+                    break  # next rung of the batch/remat ladder
+                from paddle_tpu.ops import pallas_ops
+                if pallas_ops._DISABLE:
+                    break  # already on the jnp path; a real error — next rung
+                # compile/runtime error in the Pallas path: fall back to the
+                # XLA-fused jnp attention and retry the same variant. The
+                # bench must always record a number (r01/r02 recorded none).
+                pallas_ops._DISABLE = True
+                sys.stderr.write(
+                    f"bench: disabling Pallas flash after: {last_err}\n")
+        if done:
             break
-        except Exception as e:  # OOM → next rung of the ladder
-            if "RESOURCE_EXHAUSTED" not in str(e) and \
-                    "Out of memory" not in str(e):
-                raise
-            # keep only the message: the traceback would pin the failed
-            # variant's multi-GB locals in HBM while the next rung runs
-            last_err = RuntimeError(str(e))
-            del e
-            import gc
-            gc.collect()
-    else:
+    if not done:
         raise last_err
 
     n_params = sum(int(np.prod(a.shape))
@@ -127,7 +147,11 @@ def main():
     mfu = 100.0 * flops / dt / _peak_flops(dev)
     tok_per_sec = tokens / dt
 
-    result = {
+    from paddle_tpu.ops import pallas_ops
+    used_flash = pallas_ops.flash_attention_available(
+        (B, S, cfg.num_attention_heads,
+         cfg.hidden_size // cfg.num_attention_heads))
+    return {
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu, 2),
         "unit": "percent_mfu",
@@ -138,11 +162,51 @@ def main():
             "n_params": n_params,
             "device": getattr(dev, "device_kind", str(dev)),
             "batch": B, "seq": S,
+            "attention": "pallas_flash" if used_flash else "xla_jnp",
             "remat_policy": cfg.remat_policy if cfg.use_remat else "none",
         },
     }
-    print(json.dumps(result))
+
+
+def _error_result(msg):
+    return {
+        "metric": "llama_train_mfu_1chip",
+        "value": 0.0,
+        "unit": "percent_mfu",
+        "vs_baseline": 0.0,
+        "error": msg[-1500:] or "unknown",
+    }
+
+
+def run():
+    """Never exit without the JSON line: a failed bench prints value 0.0
+    with the error attached, and a watchdog covers hangs (e.g. a dead TPU
+    tunnel blocking backend init) by printing the error record before the
+    driver's own timeout kills the process silently."""
+    import os
+    import threading
+
+    timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1200"))
+    box = {}
+
+    def _measure():
+        try:
+            box["result"] = main()
+        except BaseException as e:  # noqa: BLE001 — the line must print
+            box["result"] = _error_result(str(e) or repr(e))
+
+    t = threading.Thread(target=_measure, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        print(json.dumps(_error_result(
+            f"bench timed out after {timeout_s:.0f}s "
+            "(device init or compile hang)")))
+        sys.stdout.flush()
+        os._exit(0)  # a hung backend thread would block a clean exit
+    print(json.dumps(box["result"]))
+    return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(run())

@@ -11,6 +11,19 @@ per-row logsumexp, and a true flash backward (dq kernel + dk/dv kernel)
 that recomputes attention probabilities block-wise from the saved LSE —
 no O(S^2) materialization in either direction.
 
+TPU layout notes (Mosaic tiling):
+- Every HBM<->VMEM block must have its last dim divisible by 128 (or equal
+  to the array dim) and its second-to-last divisible by 8 (or equal) —
+  see ``mosaic_block_legal`` below, which mirrors the rule in
+  jax/_src/pallas/mosaic/lowering.py::_check_block_mappings and is unit
+  tested against every BlockSpec this module creates.
+- Per-row statistics (LSE) therefore travel as [.., S, 128] tiles with the
+  scalar replicated across the 128 lanes — the same layout jax's reference
+  TPU flash attention uses — never as a bare [.., S] vector, whose (1, bq)
+  block is Mosaic-illegal. The delta term (rowsum(g*o)) is computed inside
+  the backward kernels from the g/o blocks, so it needs no HBM layout at
+  all.
+
 Set ``_INTERPRET = True`` (tests do) to run the kernels through the Pallas
 interpreter on CPU for numerical validation without TPU hardware.
 """
@@ -23,13 +36,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["causal_attention", "flash_attention_available"]
+__all__ = ["causal_attention", "flash_attention_available",
+           "mosaic_block_legal", "flash_block_specs"]
 
 _BQ = 256
 _BK = 256
+_LANES = 128  # TPU lane width; row stats are replicated across it
 
 # Flip to True to force the Pallas path through the interpreter (CPU tests).
 _INTERPRET = False
+
+# Escape hatch: force the XLA-fused jnp path even on TPU (bench.py flips
+# this when a Pallas kernel fails to compile, so a kernel regression can
+# never cost the run its number).
+_DISABLE = False
 
 
 def _on_tpu():
@@ -40,9 +60,48 @@ def _on_tpu():
 
 
 def flash_attention_available(q_shape):
+    if _DISABLE:
+        return False
     B, S, H, D = q_shape
     shapes_ok = D % 128 == 0 and S % _BQ == 0 and S % _BK == 0 and S >= _BQ
     return shapes_ok and (_on_tpu() or _INTERPRET)
+
+
+def mosaic_block_legal(block_shape, array_shape, dtype_bits=32):
+    """Pure-shape mirror of Mosaic's _check_block_mappings rule.
+
+    rank >= 2: last block dim divisible by 128 or equal to the array dim,
+    second-to-last divisible by 8 or equal. rank 1: divisible by
+    128 * (32 // dtype_bits) or equal.
+    """
+    bs = tuple(int(d) for d in block_shape)
+    ashape = tuple(int(d) for d in array_shape)
+    if len(bs) != len(ashape) or len(bs) < 1:
+        return False
+    if len(bs) >= 2:
+        ok_last = bs[-1] == ashape[-1] or bs[-1] % 128 == 0
+        ok_sub = bs[-2] == ashape[-2] or bs[-2] % 8 == 0
+        return ok_last and ok_sub
+    tiling = 128 * (32 // dtype_bits)
+    return bs[0] == ashape[0] or bs[0] % tiling == 0
+
+
+def flash_block_specs(BH, S, D):
+    """(block_shape, array_shape) for every HBM operand of the three flash
+    kernels — the single source the pallas_calls below and the shape unit
+    test both consume."""
+    qblk = ((1, _BQ, D), (BH, S, D))
+    kblk = ((1, _BK, D), (BH, S, D))
+    full = ((1, S, D), (BH, S, D))
+    lse_blk = ((1, _BQ, _LANES), (BH, S, _LANES))
+    lse_full = ((1, S, _LANES), (BH, S, _LANES))
+    return {
+        "fwd": {"in": [qblk, full, full], "out": [qblk, lse_blk]},
+        "bwd_dq": {"in": [qblk, full, full, qblk, qblk, lse_blk],
+                   "out": [qblk]},
+        "bwd_dkv": {"in": [full, kblk, kblk, full, full, lse_full],
+                    "out": [kblk, kblk]},
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -63,6 +122,14 @@ def _attention_jnp(q, k, v):
     return jnp.swapaxes(out, 1, 2)
 
 
+def _rep_lanes(col, n_lanes):
+    """[R, 1] -> [R, n_lanes] via the broadcast-to-128-then-tile idiom that
+    Mosaic is known to lower (jax's reference flash kernel does the same)."""
+    t = jnp.broadcast_to(col, (col.shape[0], _LANES))
+    reps = n_lanes // _LANES
+    return t if reps == 1 else jnp.tile(t, (1, reps))
+
+
 # ---------------------------------------------------------------------------
 # Pallas flash forward (emits LSE for the backward)
 # ---------------------------------------------------------------------------
@@ -77,47 +144,51 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale):
     n_kblocks = (qi * bq + bq + bk - 1) // bk  # causal: skip fully-masked
 
     def body(i, carry):
-        m, l, acc = carry
+        m, l, acc = carry                      # m, l: [bq, 128]
         k = k_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         k_pos = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + lax.dot(
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1)[:, None])   # [bq, 128]
+        p = jnp.exp(s - _rep_lanes(m_new[:, :1], bk))
+        corr = jnp.exp(m - m_new)                              # [bq, 128]
+        l_new = l * corr + jnp.sum(p, axis=-1)[:, None]
+        acc_new = acc * _rep_lanes(corr[:, :1], D) + lax.dot(
             p, v, preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
+    m0 = jnp.full((bq, _LANES), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, _LANES), jnp.float32)
     acc0 = jnp.zeros((bq, D), jnp.float32)
     m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    o_ref[0] = (acc / _rep_lanes(l[:, :1], D)).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)                                # [bq, 128]
 
 
 def _flash_fwd(q, k, v):
-    """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S] fp32)."""
+    """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S,128] fp32, value
+    replicated across the trailing lane dim)."""
     from jax.experimental import pallas as pl
     BH, S, D = q.shape
     scale = 1.0 / math.sqrt(D)
+    specs = flash_block_specs(BH, S, D)["fwd"]
     grid = (BH, S // _BQ)
+    blocked = lambda b, i: (b, i, 0)  # noqa: E731
+    whole = lambda b, i: (b, 0, 0)    # noqa: E731
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, bq=_BQ, bk=_BK, scale=scale),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-                   jax.ShapeDtypeStruct((BH, S), jnp.float32)),
+                   jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec(specs["in"][0][0], blocked),
+            pl.BlockSpec(specs["in"][1][0], whole),
+            pl.BlockSpec(specs["in"][2][0], whole),
         ],
-        out_specs=(pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, _BQ), lambda b, i: (b, i))),
+        out_specs=(pl.BlockSpec(specs["out"][0][0], blocked),
+                   pl.BlockSpec(specs["out"][1][0], blocked)),
         interpret=_INTERPRET,
     )(q, k, v)
     return out, lse
@@ -127,16 +198,19 @@ def _flash_fwd(q, k, v):
 # Pallas flash backward: dq kernel (loops over k blocks)
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
                          dq_ref, *, bq, bk, scale):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)            # [bq, D]
     g = g_ref[0].astype(jnp.float32)            # [bq, D]
-    lse = lse_ref[0]                            # [bq]
-    delta = delta_ref[0]                        # [bq]
+    o = o_ref[0].astype(jnp.float32)            # [bq, D]
+    lse = lse_ref[0]                            # [bq, 128]
+    delta = jnp.sum(g * o, axis=-1)[:, None]    # [bq, 1]
     D = q.shape[-1]
     q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    lse_bk = _rep_lanes(lse[:, :1], bk)         # [bq, bk]
+    delta_bk = _rep_lanes(delta, bk)            # [bq, bk]
 
     n_kblocks = (qi * bq + bq + bk - 1) // bk
 
@@ -146,10 +220,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         k_pos = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse_bk), 0.0)
         dp = lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta_bk)
         return dq + lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq = lax.fori_loop(0, n_kblocks, body, jnp.zeros((bq, D), jnp.float32))
@@ -160,7 +234,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 # Pallas flash backward: dk/dv kernel (loops over q blocks)
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
                           dk_ref, dv_ref, *, bq, bk, scale, n_qblocks):
     from jax.experimental import pallas as pl
     ki = pl.program_id(1)
@@ -175,17 +249,19 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
         g = g_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        o = o_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq), :]  # [bq, 128]
+        delta = jnp.sum(g * o, axis=-1)[:, None]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
         q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(q_pos >= k_pos,
+                      jnp.exp(s - _rep_lanes(lse[:, :1], bk)), 0.0)
         dv_new = dv + lax.dot_general(p, g, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - _rep_lanes(delta, bk))
         dk_new = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk_new, dv_new
@@ -198,31 +274,33 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, g, o, lse):
-    """All inputs [BH, S, D] (lse [BH, S]); returns dq, dk, dv."""
+    """q,k,v,g,o: [BH, S, D]; lse: [BH, S, 128]; returns dq, dk, dv."""
     from jax.experimental import pallas as pl
     BH, S, D = q.shape
     scale = 1.0 / math.sqrt(D)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    specs = flash_block_specs(BH, S, D)
 
-    full = lambda b, i: (b, 0, 0)  # noqa: E731
-    full1 = lambda b, i: (b, 0)    # noqa: E731
+    blocked = lambda b, i: (b, i, 0)  # noqa: E731
+    whole = lambda b, i: (b, 0, 0)    # noqa: E731
 
+    dq_specs = specs["bwd_dq"]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, bq=_BQ, bk=_BK, scale=scale),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         grid=(BH, S // _BQ),
         in_specs=[
-            pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, _BQ), lambda b, i: (b, i)),
-            pl.BlockSpec((1, _BQ), lambda b, i: (b, i)),
+            pl.BlockSpec(dq_specs["in"][0][0], blocked),   # q
+            pl.BlockSpec(dq_specs["in"][1][0], whole),     # k
+            pl.BlockSpec(dq_specs["in"][2][0], whole),     # v
+            pl.BlockSpec(dq_specs["in"][3][0], blocked),   # g
+            pl.BlockSpec(dq_specs["in"][4][0], blocked),   # o
+            pl.BlockSpec(dq_specs["in"][5][0], blocked),   # lse
         ],
-        out_specs=pl.BlockSpec((1, _BQ, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec(dq_specs["out"][0][0], blocked),
         interpret=_INTERPRET,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, o, lse)
 
+    dkv_specs = specs["bwd_dkv"]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, bq=_BQ, bk=_BK, scale=scale,
                           n_qblocks=S // _BQ),
@@ -230,17 +308,17 @@ def _flash_bwd(q, k, v, g, o, lse):
                    jax.ShapeDtypeStruct((BH, S, D), v.dtype)),
         grid=(BH, S // _BK),
         in_specs=[
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), full),
-            pl.BlockSpec((1, S), full1),
-            pl.BlockSpec((1, S), full1),
+            pl.BlockSpec(dkv_specs["in"][0][0], whole),    # q
+            pl.BlockSpec(dkv_specs["in"][1][0], blocked),  # k
+            pl.BlockSpec(dkv_specs["in"][2][0], blocked),  # v
+            pl.BlockSpec(dkv_specs["in"][3][0], whole),    # g
+            pl.BlockSpec(dkv_specs["in"][4][0], whole),    # o
+            pl.BlockSpec(dkv_specs["in"][5][0], whole),    # lse
         ],
-        out_specs=(pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, _BK, D), lambda b, i: (b, i, 0))),
+        out_specs=(pl.BlockSpec(dkv_specs["out"][0][0], blocked),
+                   pl.BlockSpec(dkv_specs["out"][1][0], blocked)),
         interpret=_INTERPRET,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, o, lse)
     return dq, dk, dv
 
 

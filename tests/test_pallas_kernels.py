@@ -80,3 +80,37 @@ def test_flash_backward_under_jit():
     dq, dk, dv = step(q, k, v)
     assert dq.shape == q.shape and dk.shape == k.shape and dv.shape == v.shape
     assert np.isfinite(np.asarray(dq)).all()
+
+
+def test_block_specs_mosaic_legal():
+    """Pure shape arithmetic: every HBM block of the three flash kernels
+    satisfies Mosaic's divisible-or-full rule (the r02 bench failure class).
+    """
+    for BH, S, D in [(64, 2048, 128), (4, 512, 128), (1, 256, 256)]:
+        specs = pallas_ops.flash_block_specs(BH, S, D)
+        for kernel, groups in specs.items():
+            for io in ("in", "out"):
+                for blk, arr in groups[io]:
+                    assert pallas_ops.mosaic_block_legal(blk, arr), (
+                        f"{kernel}/{io}: block {blk} illegal for array {arr}")
+
+
+def test_mosaic_lowering_hardware_free():
+    """Lower the actual Pallas kernels for the TPU platform on CPU via
+    jax.export — runs _check_block_mappings and the full kernel-body
+    lowering to the Mosaic dialect, catching TPU-only compile errors that
+    interpreter-mode tests skip (exactly how the r01/r02 LSE BlockSpec bug
+    shipped)."""
+    import jax.export
+    BH, S, D = 4, 1024, 128
+    q = jnp.zeros((BH, S, D), jnp.bfloat16)
+    lse = jnp.zeros((BH, S, 128), jnp.float32)
+    # fixture sets _INTERPRET=True; lowering must see the real kernels
+    pallas_ops._INTERPRET = False
+    try:
+        jax.export.export(jax.jit(pallas_ops._flash_fwd),
+                          platforms=["tpu"])(q, q, q)
+        jax.export.export(jax.jit(pallas_ops._flash_bwd),
+                          platforms=["tpu"])(q, q, q, q, q, lse)
+    finally:
+        pallas_ops._INTERPRET = True
