@@ -10,8 +10,9 @@ TPU-native: ZeRO is a *placement decision*, not a runtime:
                    when grad outputs are marked sharded);
 - stage 3 (p_g_os):+ parameters sharded, all-gathered per use (GSPMD emits
                    the gathers from the param shardings).
-`group_sharded_parallel` annotates parameters; the jit train step's
-in/out shardings (see distributed.training.make_sharded_step) realize it.
+`group_sharded_parallel` annotates parameters; a jit'd train step realizes
+the placement through its in/out shardings (see
+models.llama.build_train_step for the flagship example).
 """
 from __future__ import annotations
 

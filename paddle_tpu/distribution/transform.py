@@ -231,12 +231,37 @@ class ChainTransform(Transform):
             y = t._inverse(y)
         return y
 
+    @property
+    def _domain_event_dim(self):
+        # reference transform.py:581-606 (ChainTransform._domain): the
+        # chain's input event rank is the max lower bound propagated
+        # backwards through each transform's rank delta
+        event_rank = self.transforms[-1]._codomain_event_dim
+        for t in reversed(self.transforms):
+            event_rank -= t._codomain_event_dim - t._domain_event_dim
+            event_rank = max(event_rank, t._domain_event_dim)
+        return event_rank
+
+    @property
+    def _codomain_event_dim(self):
+        event_rank = self.transforms[0]._domain_event_dim
+        for t in self.transforms:
+            event_rank += t._codomain_event_dim - t._domain_event_dim
+            event_rank = max(event_rank, t._codomain_event_dim)
+        return event_rank
+
     def _forward_log_det_jacobian(self, x):
+        # reference transform.py:556-565: each component's ldj is summed
+        # over (chain event rank - component domain rank) trailing dims so
+        # every term is reduced to the same batch shape; the running rank
+        # tracks shape-changing components
         total = 0.0
+        event_rank = self._domain_event_dim
         for t in self.transforms:
             total = total + _sum_event(t._forward_log_det_jacobian(x),
-                                       t._domain_event_dim)
+                                       event_rank - t._domain_event_dim)
             x = t._forward(x)
+            event_rank += t._codomain_event_dim - t._domain_event_dim
         return total
 
     def forward_shape(self, shape):

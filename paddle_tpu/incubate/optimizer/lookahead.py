@@ -31,12 +31,19 @@ class LookAhead:
     def step(self):
         self.inner_optimizer.step()
         self._k_count += 1
+        if self._k_count == 1:
+            # snapshot slow weights from the params at the first step
+            # (reference lookahead.py:235-238, cond_1: slow_var starts as
+            # the param, NOT zero — zero-init would scale all weights by
+            # alpha at the first sync and silently corrupt training)
+            for p in self._parameter_list:
+                self._slow[id(p)] = p._array
         if self._k_count % self.k != 0:
             return
         for p in self._parameter_list:
             slow = self._slow.get(id(p))
-            if slow is None:
-                slow = jnp.zeros_like(p._array)  # paddle inits slow to 0
+            if slow is None:  # param added after the first step
+                slow = p._array
             slow = slow + self.alpha * (p._array - slow)
             self._slow[id(p)] = slow
             p._set_array(slow.astype(p._array.dtype))

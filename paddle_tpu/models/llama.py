@@ -310,7 +310,7 @@ def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None):
 # ---------------------------------------------------------------------------
 
 def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
-                     n_microbatches=None, zero=True):
+                     n_microbatches=None, zero=True, schedule="gpipe"):
     """Compiled full training step over the hybrid mesh.
 
     Returns (step_fn, init_fn):
@@ -318,8 +318,10 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
       opt-state sharding over 'dp').
       step_fn(params, opt_state, batch) -> (params, opt_state, metrics).
 
-    use_pp: pipeline over the 'pp' axis with shard_map (GPipe schedule);
-    defaults to pp_degree > 1.
+    use_pp: pipeline over the 'pp' axis with shard_map; defaults to
+    pp_degree > 1. schedule: "gpipe" (autodiff-transposed scan) or "1f1b"
+    (hand-scheduled forward/backward interleave, O(pp) activation
+    residency — reference pipeline_parallel.py:228).
     """
     import optax
     mesh = topo.mesh
@@ -328,7 +330,15 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     specs = param_specs(cfg)
 
-    if use_pp:
+    grad_fn = None
+    if use_pp and schedule == "1f1b":
+        from ..distributed.pipeline import pipeline_1f1b_value_and_grad
+
+        def grad_fn(params, batch):
+            total, ce, grads = pipeline_1f1b_value_and_grad(
+                cfg, mesh, n_microbatches or pp, params, batch)
+            return (total, ce), grads
+    elif use_pp:
         from ..distributed.pipeline import pipeline_loss_fn
         loss = functools.partial(pipeline_loss_fn, cfg, mesh,
                                  n_microbatches or pp)
@@ -366,30 +376,46 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
                 out_shardings=None)(params)
             # re-place opt state with ZeRO sharding
             def place(x, pspec):
-                if hasattr(x, "shape") and x.ndim > 0:
-                    return jax.device_put(
-                        x, NamedSharding(mesh, zero_shard_spec(
-                            pspec, x.shape)))
-                return x
+                if not hasattr(x, "shape"):
+                    return x
+                if x.ndim == 0:
+                    # scalars (Adam count etc.) replicate over the mesh —
+                    # leaving them on one device makes the state tree's
+                    # device assignments inconsistent, which jit rejects
+                    # once the leaves are committed (e.g. after a
+                    # checkpoint restore)
+                    return jax.device_put(x, NamedSharding(mesh, P()))
+                return jax.device_put(
+                    x, NamedSharding(mesh, zero_shard_spec(
+                        pspec, x.shape)))
 
-            def spec_of(x, path_spec):
-                return path_spec
+            # map each opt-state leaf to the spec of its matching param by
+            # pytree path: optax states (mu/nu/trace/...) mirror the param
+            # tree under a state-field prefix, so the param's path is a
+            # suffix of the state leaf's path. Shape-keyed matching would
+            # collide for same-shape params (wq/wo both (L,H,H)) and hand
+            # Adam moments the wrong placement.
+            flat_specs, _ = jax.tree_util.tree_flatten_with_path(
+                specs, is_leaf=lambda s: isinstance(s, P))
+            spec_by_path = [(jax.tree_util.keystr(path), s)
+                            for path, s in flat_specs]
 
-            # map each opt-state leaf to the spec of its matching param if
-            # shapes align, else replicate
-            flat_params, tdef = jax.tree_util.tree_flatten(params)
-            shapes = {p.shape: s for p, s in zip(
-                flat_params, jax.tree_util.tree_leaves(
-                    specs, is_leaf=lambda s: isinstance(s, P)))}
+            def place_leaf(path, x):
+                key = jax.tree_util.keystr(path)
+                pspec = next((s for pk, s in spec_by_path
+                              if key.endswith(pk)), P())
+                return place(x, pspec)
 
-            opt_state = jax.tree_util.tree_map(
-                lambda x: place(x, shapes.get(getattr(x, "shape", None),
-                                              P())), opt_state)
+            opt_state = jax.tree_util.tree_map_with_path(
+                place_leaf, opt_state)
         return params, opt_state
 
     def step(params, opt_state, batch):
-        (total, ce), grads = jax.value_and_grad(
-            lambda p: loss(p, batch), has_aux=True)(params)
+        if grad_fn is not None:
+            (total, ce), grads = grad_fn(params, batch)
+        else:
+            (total, ce), grads = jax.value_and_grad(
+                lambda p: loss(p, batch), has_aux=True)(params)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": total, "ce": ce}

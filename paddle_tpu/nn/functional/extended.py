@@ -32,7 +32,14 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     (reference: nn/functional/extension.py sequence_mask)."""
     x = _ensure_tensor(x)
     if maxlen is None:
-        maxlen = int(np.asarray(x._array).max())
+        from jax.core import Tracer
+        if isinstance(x._array, Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) under jit would make the mask "
+                "width data-dependent (XLA needs static shapes); pass an "
+                "explicit maxlen")
+        # scalar readback only (not the whole array) to size the mask
+        maxlen = int(jnp.max(x._array))
     from ...core.dtype import convert_dtype
 
     def _f(a):

@@ -21,17 +21,23 @@ __all__ = ["viterbi_decode", "ViterbiDecoder"]
 
 
 def _viterbi_arrays(potentials, transition, lengths, include_bos_eos_tag):
-    """potentials [B, L, N] fp, transition [N, N], lengths [B] int."""
+    """potentials [B, L, N] fp, transition [N, N], lengths [B] int.
+
+    BOS/EOS semantics mirror the reference kernel
+    (paddle/phi/kernels/cpu/viterbi_decode_kernel.cc:229-279: the
+    transition matrix's LAST row is the start tag, the SECOND-TO-LAST row
+    the stop tag; the start row is added at t=0, the stop row at each
+    sequence's last valid step, and no tag is barred from emission)."""
     B, L, N = potentials.shape
+    lengths = lengths.astype(jnp.int32)
     pots = jnp.swapaxes(potentials, 0, 1)  # [L, B, N]
     steps = jnp.arange(1, L)
 
     if include_bos_eos_tag:
-        # reference semantics: tag N-2 is BOS, N-1 is EOS — neither can be
-        # emitted at any timestep, so penalize them in every potential
-        tag_mask = jnp.full((N,), -1e4).at[:N - 2].set(0.0)
-        pots = pots + tag_mask[None, None, :]
-        alpha0 = pots[0] + transition[N - 2][None, :]
+        start_row = transition[N - 1][None, :]
+        stop_row = transition[N - 2][None, :]
+        alpha0 = pots[0] + start_row
+        alpha0 = alpha0 + jnp.where((lengths == 1)[:, None], stop_row, 0.0)
     else:
         alpha0 = pots[0]
 
@@ -44,12 +50,12 @@ def _viterbi_arrays(potentials, transition, lengths, include_bos_eos_tag):
         # sequences already past their length keep their alpha
         active = (t < lengths)[:, None]
         new_alpha = jnp.where(active, new_alpha, alpha)
+        if include_bos_eos_tag:
+            new_alpha = new_alpha + jnp.where(
+                (t == lengths - 1)[:, None], stop_row, 0.0)
         return new_alpha, (best_prev, active)
 
     alpha, (history, actives) = lax.scan(step, alpha0, steps)
-
-    if include_bos_eos_tag:
-        alpha = alpha + transition[:, N - 1][None, :]
 
     scores = jnp.max(alpha, axis=-1)
     last_tag = jnp.argmax(alpha, axis=-1)               # [B]

@@ -125,3 +125,30 @@ def test_pipeline_grads_match_dense():
     np.testing.assert_allclose(
         np.asarray(g_pp["layers"]["wq"]), np.asarray(g_dense["layers"]["wq"]),
         rtol=5e-4, atol=1e-5)
+
+
+def test_zero_optstate_sharding_matches_param_by_path():
+    """Adam moments get their own param's placement (path-matched), not a
+    same-shape sibling's: wq (column-parallel) and wo (row-parallel) share
+    a shape, so shape-keyed matching would collide."""
+    from paddle_tpu.distributed.mesh import HybridTopology
+    from paddle_tpu.models.llama import build_train_step
+
+    topo = HybridTopology(dp=2, pp=2, sharding=1, mp=2,
+                          devices=jax.devices()[:8])
+    cfg = _tiny_cfg(num_hidden_layers=4, hidden_size=64,
+                    intermediate_size=64, vocab_size=128)
+    _, init_fn = build_train_step(cfg, topo, use_pp=False)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+
+    mu_specs = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+        key = jax.tree_util.keystr(path)
+        if ".mu" in key and hasattr(leaf, "sharding"):
+            mu_specs[key] = tuple(leaf.sharding.spec)
+    wq = next(s for k, s in mu_specs.items() if "'wq'" in k)
+    wo = next(s for k, s in mu_specs.items() if "'wo'" in k)
+    # wq: P("pp", None, "mp") + ZeRO dp on dim 1; wo: P("pp", "mp", None)
+    # + ZeRO dp on dim 2 — distinct placements for identical shapes
+    assert wq == ("pp", "dp", "mp"), wq
+    assert wo == ("pp", "mp", "dp"), wo

@@ -138,6 +138,14 @@ def test_transform_bijections():
     y = chain._forward(x)
     np.testing.assert_allclose(np.asarray(y), np.exp(2 * np.asarray(x)),
                                rtol=1e-5)
+    # chain event-dim accounting (reference transform.py:556-565): a
+    # rank-0 component's ldj is summed up to the chain's event rank when
+    # chained with an event-rank-1 component
+    chain2 = T.ChainTransform([T.AffineTransform(0.0, 2.0),
+                               T.StickBreakingTransform()])
+    assert chain2._domain_event_dim == 1
+    xb = jnp.ones((5, 3))
+    assert chain2._forward_log_det_jacobian(xb).shape == (5,)
     # stick breaking maps to the simplex and inverts
     sb = T.StickBreakingTransform()
     z = jnp.asarray([0.3, -0.2, 0.5])

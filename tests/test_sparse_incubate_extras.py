@@ -102,9 +102,29 @@ def test_lookahead_interpolates_to_slow_weights():
         loss.backward()
         la.step()
         la.clear_grad()
-    # after k steps weights = slow(0) + alpha*(fast - slow) = alpha*fast
-    # (slow initialized to zero in the reference)
     assert not np.allclose(net.weight.numpy(), w0)
+
+
+def test_lookahead_slow_weights_init_from_params():
+    """Slow weights snapshot the params at the first step (reference
+    lookahead.py cond_1), NOT zero — zero-init would shrink every weight
+    by alpha at the first sync. With alpha=0 the first sync must restore
+    the step-1 weights exactly."""
+    net = nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    la = paddle.incubate.optimizer.LookAhead(inner, alpha=0.0, k=2)
+    snapshots = []
+    for _ in range(2):
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        snapshots.append(net.weight.numpy().copy())
+    # sync at step 2 with alpha=0 → weights == slow == step-1 weights
+    np.testing.assert_allclose(snapshots[1], snapshots[0], rtol=1e-6)
+    assert not np.allclose(snapshots[0], 0.0)
 
 
 def test_modelaverage_apply_restore():

@@ -54,8 +54,43 @@ def test_viterbi_decoder_layer():
     lengths = paddle.to_tensor(np.array([5, 4], np.int64))
     scores, paths = dec(pots, lengths)
     assert tuple(paths.shape) == (2, 5)
-    # bos/eos tags reserve the last two ids; emitted tags must avoid them
-    assert np.asarray(paths.numpy()).max() < 4
+    assert np.isfinite(np.asarray(scores.numpy())).all()
+
+
+def _np_viterbi_bos_eos(emissions, transition, length):
+    """Exhaustive search mirroring the reference kernel's BOS/EOS rule
+    (viterbi_decode_kernel.cc:229-279): + transition[N-1, tags[0]] at the
+    start, + transition[N-2, tags[-1]] at the last valid step; every tag
+    id (including the two special rows) may be emitted."""
+    import itertools
+    L, N = emissions.shape
+    best_score, best_path = -np.inf, None
+    for tags in itertools.product(range(N), repeat=length):
+        s = transition[N - 1, tags[0]] + emissions[0, tags[0]]
+        for t in range(1, length):
+            s += transition[tags[t - 1], tags[t]] + emissions[t, tags[t]]
+        s += transition[N - 2, tags[length - 1]]
+        if s > best_score:
+            best_score, best_path = s, list(tags)
+    return best_score, best_path
+
+
+def test_viterbi_decode_bos_eos_matches_reference():
+    rng = np.random.default_rng(7)
+    B, L, N = 3, 4, 5
+    pots = rng.standard_normal((B, L, N)).astype(np.float32)
+    trans = rng.standard_normal((N, N)).astype(np.float32)
+    lengths = np.array([4, 2, 1], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pots), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=True)
+    for b in range(B):
+        ref_score, ref_path = _np_viterbi_bos_eos(
+            pots[b], trans, int(lengths[b]))
+        np.testing.assert_allclose(float(scores.numpy()[b]), ref_score,
+                                   rtol=1e-5, err_msg=f"seq {b}")
+        got = list(np.asarray(paths.numpy())[b][:int(lengths[b])])
+        assert got == ref_path, (b, got, ref_path)
 
 
 def test_text_datasets():
