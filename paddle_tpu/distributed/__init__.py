@@ -21,6 +21,7 @@ from .random import RNGStatesTracker, get_rng_state_tracker, \
 from .recompute import recompute, recompute_sequential
 from . import fleet
 from . import sharding
+from . import checkpoint
 from . import pipeline
 from . import rpc
 from . import auto_parallel

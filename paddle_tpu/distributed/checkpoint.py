@@ -1,0 +1,143 @@
+"""Sharded distributed checkpointing.
+
+Reference analog: python/paddle/distributed/auto_parallel/dist_saver.py
+(DistributedSaver.save/load — per-rank shard files ``model.pdmodel`` +
+dist_attr manifests, manually re-merged and re-sliced when the restore
+topology differs) and fleet's save_persistables
+(python/paddle/distributed/fleet/fleet.py).
+
+TPU-native: orbax writes ONE logical checkpoint for the whole mesh (every
+host writes only its local shards, OCDBT/tensorstore format), and restore
+re-shards to ANY target mesh/spec through the target tree's
+NamedShardings — the reference's manual merge/re-slice pass collapses
+into device_put-on-restore. Saving is async: the train loop keeps
+stepping while shards stream out (``sync=False``).
+
+Typical use with the flagship train step (models.llama.build_train_step):
+
+    step_fn, init_fn = build_train_step(cfg, topo)
+    params, opt_state = init_fn(rng)
+    ...train...
+    dckpt.save_train_state(ckdir, params, opt_state, step=1000)
+
+    # later, on a DIFFERENT mesh shape:
+    step_fn2, init_fn2 = build_train_step(cfg, topo2)
+    target = init_fn2(rng)                      # placement donor
+    params, opt_state, step = dckpt.load_train_state(ckdir, *target)
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Optional, Tuple
+
+import jax
+
+__all__ = ["save", "load", "save_train_state", "load_train_state",
+           "latest_step", "abstract_like", "wait_until_finished"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+_CKPTR = None
+
+
+def _checkpointer():
+    # module-level singleton: async saves (sync=False) stay awaitable via
+    # wait_until_finished() instead of dying with a discarded local
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def wait_until_finished():
+    """Block until every async save (sync=False) has committed."""
+    if _CKPTR is not None:
+        _CKPTR.wait_until_finished()
+
+
+def abstract_like(tree):
+    """Pytree of ShapeDtypeStructs carrying each leaf's sharding — the
+    restore target that tells orbax where every shard of every array must
+    land on the *current* mesh."""
+    def conv(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        return x
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def save(path: str, tree: Any, *, overwrite: bool = True,
+         sync: bool = True) -> None:
+    """Save a pytree of (sharded) arrays as one logical checkpoint."""
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    ckptr = _checkpointer()
+    ckptr.save(path, tree)
+    if sync:
+        ckptr.wait_until_finished()
+
+
+def load(path: str, target: Any = None) -> Any:
+    """Restore a checkpoint. ``target`` (a tree of arrays or
+    ShapeDtypeStructs) dictates shapes/dtypes/shardings on the current
+    mesh — pass the init_fn output of the new topology to reshard; omit it
+    to restore with the shardings recorded at save time."""
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    if target is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, abstract_like(target))
+
+
+def latest_step(root: str) -> Optional[int]:
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        return None
+    steps = [int(m.group(1)) for d in os.listdir(root)
+             if (m := _STEP_RE.match(d))]
+    return max(steps) if steps else None
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(os.path.abspath(root), f"step_{step:08d}")
+
+
+def save_train_state(root: str, params: Any, opt_state: Any, step: int,
+                     *, keep: int = 3, sync: bool = True) -> str:
+    """Save (params, opt_state) under root/step_N, pruning old steps."""
+    d = _step_dir(root, step)
+    save(d, {"params": params, "opt_state": opt_state}, sync=sync)
+    steps = sorted(int(m.group(1)) for x in os.listdir(os.path.abspath(root))
+                   if (m := _STEP_RE.match(x)))
+    for s in steps[:-keep] if keep else []:
+        shutil.rmtree(_step_dir(root, s), ignore_errors=True)
+    return d
+
+
+def load_train_state(root: str, params_target: Any = None,
+                     opt_state_target: Any = None,
+                     step: Optional[int] = None
+                     ) -> Tuple[Any, Any, int]:
+    """Restore (params, opt_state, step) from root (latest step unless
+    given), resharded onto the targets' placements."""
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no step_* checkpoints under {root}")
+    if (params_target is None) != (opt_state_target is None):
+        raise ValueError(
+            "pass both params_target and opt_state_target (the restore "
+            "target must cover the whole saved state) or neither")
+    target = None
+    if params_target is not None:
+        target = {"params": params_target, "opt_state": opt_state_target}
+    state = load(_step_dir(root, step), target)
+    return state["params"], state["opt_state"], step
