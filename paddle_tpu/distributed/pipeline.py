@@ -1,9 +1,9 @@
-"""Pipeline parallelism — GPipe schedule over the 'pp' mesh axis.
+"""Pipeline parallelism over the 'pp' mesh axis: GPipe + 1F1B schedules.
 
 Reference analog: fleet/meta_parallel/pipeline_parallel.py:31
-(PipelineParallel.train_batch — 1F1B over NCCL p2p send/recv with
-SendRecvMeta handshakes) and pp_layers.py:209 (PipelineLayer segmenting
-python Layers per stage).
+(PipelineParallel.train_batch) and :228 (_forward_backward_pipeline — the
+1F1B steady state over NCCL p2p send/recv with SendRecvMeta handshakes)
+and pp_layers.py:209 (PipelineLayer segmenting python Layers per stage).
 
 TPU-native: the layer stack is an array axis sharded over 'pp'; the
 schedule is a lax.scan whose per-step stage handoff is ONE lax.ppermute
@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["pipeline_forward", "pipeline_loss_fn"]
+__all__ = ["pipeline_forward", "pipeline_loss_fn",
+           "pipeline_1f1b_value_and_grad"]
 
 
 def pipeline_forward(cfg, mesh, n_micro, params, ids):
@@ -98,3 +99,153 @@ def pipeline_loss_fn(cfg, mesh, n_micro, params, batch):
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     ce = -jnp.mean(ll)
     return ce + 0.01 * aux, ce
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule
+# ---------------------------------------------------------------------------
+
+def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
+    """Hand-scheduled 1F1B: returns (loss, ce, grads) directly.
+
+    Reference analog: pipeline_parallel.py:228 (_forward_backward_pipeline
+    — warmup forwards, steady 1F1B, cooldown backwards, capping in-flight
+    activations at O(pp) instead of GPipe's O(n_micro)).
+
+    TPU-native: one lax.scan of T = n_micro + 2*pp - 1 ticks inside
+    shard_map. Per tick every stage runs one forward unit (activation
+    handed to the next stage by ppermute) and one backward unit (gradient
+    handed to the previous stage by the reverse ppermute). The backward
+    unit re-derives its vjp from a ring buffer of saved *stage inputs*
+    (size 2*pp, the 1F1B residency bound: micro m is live on stage s for
+    2*(pp-s)-1 ticks) — activation recomputation, so saved state per stage
+    is 2*pp microbatch inputs regardless of n_micro, while grad-of-GPipe
+    keeps residuals for every scan step. Schedule arithmetic: F(s,m) at
+    tick s+m, B(s,m) at tick 2*pp-1-s+m; jax.grad's scan transpose is
+    replaced by explicit per-unit jax.vjp, so this function computes its
+    own grads (it is not meant to be differentiated).
+
+    The CE head runs per-microbatch inside the last stage's backward unit
+    (its vjp seeds the gradient chain). The embedding lives inside the
+    manual region too: stage 0 looks its microbatch up per forward unit
+    (ids are int32 — tiny) and accumulates d_embed as a param-sized [V,H]
+    carry per backward unit, so no O(B*S*H) activation or gradient stack
+    is ever materialized — per-stage live state really is the 2*pp ring
+    buffer plus param-sized accumulators.
+    """
+    from ..models.llama import _rope_tables, _rms_norm, run_layer_stack
+
+    ids, labels = batch["input_ids"], batch["labels"]
+    B, S = ids.shape
+    H = params["embed"].shape[1]
+    sin, cos = _rope_tables(cfg, S)
+    assert B % n_micro == 0, (B, n_micro)
+    mb = B // n_micro
+    ids_mb = ids.reshape(n_micro, mb, S)
+    lab_mb = labels.reshape(n_micro, mb, S)
+    layers = params["layers"]
+    inv_nm = 1.0 / n_micro
+
+    def stage_body(layers_local, embed_w, ids_stack, lab_stack, norm_w,
+                   head_w, sin_, cos_):
+        pp = lax.axis_size("pp")
+        stage = lax.axis_index("pp")
+        is_last = stage == pp - 1
+        BUF = 2 * pp
+        T = n_micro + 2 * pp - 1
+
+        def stage_fwd(ll, xin):
+            return run_layer_stack(cfg, ll, xin, sin_, cos_)  # (y, aux)
+
+        def head_ce(nw, hw, y, lab):
+            h = _rms_norm(y, nw, cfg.rms_norm_eps)
+            logits = (h @ hw).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, lab[..., None], -1)[..., 0]
+            return jnp.mean(lse - tgt)
+
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            (fwd_state, bwd_state, xs_buf, dlayers, dembed, dnorm, dhead,
+             ce_sum, aux_sum) = carry
+
+            # ---- forward unit: F(s, m) at t = s + m
+            fm = t - stage
+            do_f = (fm >= 0) & (fm < n_micro)
+            fidx = jnp.clip(fm, 0, n_micro - 1)
+            x_emb = jnp.take(embed_w, ids_stack[fidx], axis=0)
+            x_in = jnp.where(stage == 0, x_emb, fwd_state)
+            y, _ = stage_fwd(layers_local, x_in)
+            xs_upd = lax.dynamic_update_index_in_dim(
+                xs_buf, x_in, fm % BUF, 0)
+            xs_buf = jnp.where(do_f, xs_upd, xs_buf)
+            fwd_state = lax.ppermute(y, "pp", fwd_perm)
+
+            # ---- backward unit: B(s, m) at t = 2*pp - 1 - s + m
+            bm = t - (2 * pp - 1 - stage)
+            do_b = (bm >= 0) & (bm < n_micro)
+            bidx = jnp.clip(bm, 0, n_micro - 1)
+            x_saved = xs_buf[bm % BUF]
+            (y_b, aux_b), stage_vjp = jax.vjp(
+                stage_fwd, layers_local, x_saved)
+            ce_m, head_vjp = jax.vjp(
+                lambda nw, hw, yy: head_ce(nw, hw, yy, lab_stack[bidx]),
+                norm_w, head_w, y_b)
+            dnorm_m, dhead_m, g_last = head_vjp(jnp.float32(inv_nm))
+            g_in = jnp.where(is_last, g_last, bwd_state)
+            dlayers_m, dx_m = stage_vjp(
+                (g_in, jnp.asarray(0.01 * inv_nm, aux_b.dtype)))
+
+            mask_b = do_b
+            dlayers = jax.tree_util.tree_map(
+                lambda acc, d: acc + jnp.where(mask_b, d, 0),
+                dlayers, dlayers_m)
+            mask_last = mask_b & is_last
+            dnorm = dnorm + jnp.where(mask_last, dnorm_m, 0)
+            dhead = dhead + jnp.where(mask_last, dhead_m, 0)
+            ce_sum = ce_sum + jnp.where(mask_last, ce_m * inv_nm, 0.0)
+            aux_sum = aux_sum + jnp.where(mask_b, aux_b * inv_nm, 0.0)
+            # embedding backward: param-sized scatter-add on stage 0 —
+            # no [n_micro, mb, S, H] gradient stack in the carry
+            demb_m = jnp.zeros_like(dembed).at[ids_stack[bidx]].add(
+                dx_m.astype(dembed.dtype))
+            dembed = dembed + jnp.where(mask_b & (stage == 0), demb_m, 0)
+            bwd_state = lax.ppermute(dx_m, "pp", bwd_perm)
+
+            return (fwd_state, bwd_state, xs_buf, dlayers, dembed, dnorm,
+                    dhead, ce_sum, aux_sum), None
+
+        z = jnp.zeros((mb, S, H), embed_w.dtype)
+        carry0 = (
+            z, z, jnp.zeros((BUF, mb, S, H), embed_w.dtype),
+            jax.tree_util.tree_map(jnp.zeros_like, layers_local),
+            jnp.zeros_like(embed_w),
+            jnp.zeros_like(norm_w), jnp.zeros_like(head_w),
+            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        (fwd_state, bwd_state, xs_buf, dlayers, dembed, dnorm, dhead,
+         ce_sum, aux_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
+
+        # head/embed grads and the scalars live on one stage; psum
+        # replicates them so out_specs can be P()
+        dembed = lax.psum(dembed, "pp")
+        dnorm = lax.psum(dnorm, "pp")
+        dhead = lax.psum(dhead, "pp")
+        ce_sum = lax.psum(ce_sum, "pp")
+        aux_sum = lax.psum(aux_sum, "pp")
+        return dlayers, dembed, dnorm, dhead, ce_sum, aux_sum
+
+    layer_manual_specs = jax.tree_util.tree_map(lambda a: P("pp"), layers)
+    dlayers, dembed, dnorm, dhead, ce, aux = jax.shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(layer_manual_specs, P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(layer_manual_specs, P(), P(), P(), P(), P()),
+        axis_names={"pp"}, check_vma=False)(
+            layers, params["embed"], ids_mb, lab_mb, params["norm_f"],
+            params["lm_head"], sin, cos)
+
+    grads = {"embed": dembed, "layers": dlayers, "norm_f": dnorm,
+             "lm_head": dhead}
+    loss = ce + 0.01 * aux
+    return loss, ce, grads

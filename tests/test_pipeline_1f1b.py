@@ -1,0 +1,115 @@
+"""1F1B pipeline schedule: parity with the dense path and the activation
+memory win over GPipe.
+
+Reference analog: fleet/meta_parallel/pipeline_parallel.py:228
+(_forward_backward_pipeline) and its tests
+(hybrid_parallel_pp_alexnet.py pattern: same data through pipeline vs
+single-process, losses must match)."""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _cfg(**kw):
+    from paddle_tpu.models.llama import LlamaConfig
+    base = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=4, num_attention_heads=4,
+                num_key_value_heads=4, max_position_embeddings=64,
+                dtype=jnp.float32, use_remat=False)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _batch(cfg, B, S, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+        "labels": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32),
+    }
+
+
+def test_1f1b_matches_dense_loss_and_grads():
+    from paddle_tpu.models.llama import init_params, loss_fn
+    from paddle_tpu.distributed.pipeline import pipeline_1f1b_value_and_grad
+
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg, 8, 16)
+    (d_total, d_ce), g_dense = jax.value_and_grad(
+        lambda p: loss_fn(cfg, p, batch), has_aux=True)(params)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+    total, ce, grads = jax.jit(
+        lambda p, b: pipeline_1f1b_value_and_grad(cfg, mesh, 4, p, b))(
+            params, batch)
+    np.testing.assert_allclose(float(total), float(d_total), rtol=1e-5)
+    np.testing.assert_allclose(float(ce), float(d_ce), rtol=1e-5)
+    for name in ("embed", "lm_head", "norm_f"):
+        np.testing.assert_allclose(
+            np.asarray(grads[name]), np.asarray(g_dense[name]),
+            rtol=5e-4, atol=1e-5, err_msg=name)
+    np.testing.assert_allclose(
+        np.asarray(grads["layers"]["wq"]),
+        np.asarray(g_dense["layers"]["wq"]), rtol=5e-4, atol=1e-5)
+
+
+def test_1f1b_activation_memory_beats_gpipe():
+    """The point of 1F1B: saved activations O(pp), not O(n_micro). XLA's
+    buffer assignment shows it directly — grad-of-GPipe's temp allocation
+    grows with n_micro (it holds every scan step's residuals), 1F1B's ring
+    buffer does not."""
+    from paddle_tpu.models.llama import init_params
+    from paddle_tpu.distributed.pipeline import (
+        pipeline_1f1b_value_and_grad, pipeline_loss_fn)
+
+    cfg = _cfg(hidden_size=128, intermediate_size=256,
+               max_position_embeddings=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg, 32, 128)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("pp",))
+    n_micro = 16
+    gpipe = jax.jit(jax.grad(
+        lambda p, b: pipeline_loss_fn(cfg, mesh, n_micro, p, b)[0]))
+    f1b = jax.jit(
+        lambda p, b: pipeline_1f1b_value_and_grad(cfg, mesh, n_micro,
+                                                  p, b)[2])
+    temps = {}
+    for name, fn in (("gpipe", gpipe), ("1f1b", f1b)):
+        ma = fn.lower(params, batch).compile().memory_analysis()
+        if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+            pytest.skip("backend exposes no memory analysis")
+        temps[name] = ma.temp_size_in_bytes
+    assert temps["1f1b"] * 2 < temps["gpipe"], temps
+
+
+def test_1f1b_full_hybrid_train_step():
+    from paddle_tpu.distributed.mesh import HybridTopology
+    from paddle_tpu.models.llama import build_train_step
+
+    cfg = _cfg(hidden_size=64, intermediate_size=64)
+    topo = HybridTopology(dp=2, pp=2, sharding=1, mp=2,
+                          devices=jax.devices()[:8])
+    batch = _batch(cfg, 16, 16)
+    sh = NamedSharding(topo.mesh, P("dp", None))
+    batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        step_fn, init_fn = build_train_step(cfg, topo, use_pp=True,
+                                            n_microbatches=8,
+                                            schedule=sched)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        params, opt_state, m = step_fn(params, opt_state, batch)
+        losses[sched] = float(m["loss"])
+        assert np.isfinite(losses[sched])
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"], rtol=1e-5)
