@@ -3,7 +3,10 @@ from __future__ import annotations
 
 import threading
 
-__all__ = ["unique_name", "try_import", "flops", "dlpack", "deprecated"]
+__all__ = ["unique_name", "try_import", "flops", "dlpack", "deprecated",
+           "cpp_extension"]
+
+from . import cpp_extension
 
 
 class _UniqueNameGenerator:

@@ -1,0 +1,186 @@
+"""Custom-op / custom-kernel extension point.
+
+Reference analog:
+- python/paddle/utils/cpp_extension/ (CppExtension / CUDAExtension +
+  ``load()`` — JIT-compiles user C++/CUDA into a loadable op library)
+- paddle/fluid/framework/custom_operator.cc:733
+  (RegisterOperatorWithMetaInfo — wires a user op's kernel + grad into
+  the framework's registry)
+- paddle/phi/capi/ (stable C ABI for out-of-tree PHI kernels)
+
+TPU-native split of those capabilities:
+
+- On TPU the kernel extension *language* is Pallas, not C++ (the MXU/VPU
+  are not user-programmable through a C ABI): :func:`custom_op` registers
+  any jax-traceable callable — jnp code or a ``pallas_call`` — as a
+  framework op with an optional custom VJP. It lands in the same
+  ``ops.registry`` the built-in surface uses, works eager and under jit,
+  and differentiates through the tape like any native op.
+
+- On CPU hosts (data pipelines, tokenizers, samplers), C++ plugs in
+  through XLA's FFI custom_call ABI: :func:`load` compiles sources with
+  g++ against the XLA FFI headers bundled with jaxlib
+  (:func:`get_include`), registers every exported
+  ``XLA_FFI_DEFINE_HANDLER_SYMBOL`` handler, and returns python wrappers
+  built on ``jax.ffi.ffi_call``.
+
+Minimal C++ example (compiled and exercised in
+tests/test_cpp_extension.py)::
+
+    #include "xla/ffi/api/ffi.h"
+    namespace ffi = xla::ffi;
+    static ffi::Error AxpyImpl(ffi::Buffer<ffi::F32> x,
+                               ffi::Buffer<ffi::F32> y, float alpha,
+                               ffi::ResultBuffer<ffi::F32> out) {
+      for (size_t i = 0; i < x.element_count(); ++i)
+        out->typed_data()[i] = alpha * x.typed_data()[i] + y.typed_data()[i];
+      return ffi::Error::Success();
+    }
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(Axpy, AxpyImpl,
+        ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+                        .Arg<ffi::Buffer<ffi::F32>>()
+                        .Attr<float>("alpha")
+                        .Ret<ffi::Buffer<ffi::F32>>());
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+
+from ..core.tensor import Tensor, apply_op, to_tensor
+
+__all__ = ["custom_op", "get_include", "load", "CppExtension"]
+
+
+# ---------------------------------------------------------------------------
+# Pallas / jax custom ops (the TPU kernel extension path)
+# ---------------------------------------------------------------------------
+
+def custom_op(name: str, forward: Optional[Callable] = None, *,
+              backward: Optional[Callable] = None, n_outs: int = 1):
+    """Register a jax-traceable callable as a framework op.
+
+    forward(*arrays) -> array(s): jnp code or a pallas_call.
+    backward(*arrays, cotangent) -> tuple of input cotangents (optional;
+    jax autodiff through ``forward`` is used when omitted).
+
+    Returns the Tensor-level op (also usable as a decorator when called
+    with only ``name``). The op is recorded in ops.registry.OP_LIBRARY
+    next to the built-in surface.
+    """
+    if forward is None:
+        return lambda fn: custom_op(name, fn, backward=backward,
+                                    n_outs=n_outs)
+
+    jfn = forward
+    if backward is not None:
+        wrapped = jax.custom_vjp(forward)
+
+        def _fwd(*args):
+            return forward(*args), args
+
+        def _bwd(res, ct):
+            cts = backward(*res, ct)
+            if not isinstance(cts, (tuple, list)):
+                cts = (cts,)
+            return tuple(cts)
+
+        wrapped.defvjp(_fwd, _bwd)
+        jfn = wrapped
+
+    def op(*xs, **kw):
+        tensors = [x if isinstance(x, Tensor) else to_tensor(x) for x in xs]
+        return apply_op(jfn, *tensors, op_name=name, n_outs=n_outs, **kw)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = forward.__doc__ or f"custom op '{name}'"
+
+    from ..ops import registry
+    registry.register(name, op, jfn)
+    return op
+
+
+# ---------------------------------------------------------------------------
+# C++ host ops over the XLA FFI custom_call ABI
+# ---------------------------------------------------------------------------
+
+def get_include() -> str:
+    """Directory of the XLA FFI headers (xla/ffi/api/ffi.h) to compile
+    user C++ against — the cpp_extension ``get_include()`` analog."""
+    return jax.ffi.include_dir()
+
+
+class CppExtension:
+    """Description of a C++ extension: name + sources (+flags). The
+    setuptools-Extension analog; hand it to :func:`load`."""
+
+    def __init__(self, name: str, sources: Sequence[str],
+                 extra_compile_args: Sequence[str] = ()):
+        self.name = name
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args)
+
+
+def _default_build_dir() -> str:
+    # per-user (multi-user hosts share /tmp; a fixed path would be owned
+    # by whoever compiled first)
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return os.path.join(tempfile.gettempdir(),
+                        f"paddle_tpu_extensions_{uid}")
+
+
+def _compile(name: str, sources: Sequence[str], build_dir: str,
+             extra_cflags: Sequence[str]) -> str:
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    if os.path.exists(so_path):
+        newest_src = max(os.path.getmtime(s) for s in sources)
+        if os.path.getmtime(so_path) >= newest_src:
+            return so_path  # up to date — skip recompile
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{get_include()}", *extra_cflags, "-o", so_path, *sources]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"compiling extension '{name}' failed:\n{proc.stderr[-3000:]}")
+    return so_path
+
+
+def load(name: str, sources: Sequence[str],
+         functions: Dict[str, str],
+         extra_cflags: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         platform: str = "cpu"):
+    """Compile + register a C++ FFI extension; returns a namespace of
+    python callables (the cpp_extension ``load()`` analog).
+
+    functions: {python_name: exported_handler_symbol}. Each callable has
+    signature ``fn(*arrays, out_shape, **attrs)`` where out_shape is a
+    jax.ShapeDtypeStruct (or sequence of them) and attrs are the
+    handler's declared FFI attributes.
+    """
+    build_dir = build_directory or _default_build_dir()
+    so_path = _compile(name, sources, build_dir, extra_cflags)
+    lib = ctypes.CDLL(so_path)
+
+    ns = type(name, (), {"__so_path__": so_path})()
+    for py_name, symbol in functions.items():
+        handler = jax.ffi.pycapsule(getattr(lib, symbol))
+        target = f"{name}.{py_name}"
+        jax.ffi.register_ffi_target(target, handler, platform=platform)
+
+        def make(target):
+            def call(*args, out_shape, **attrs):
+                return jax.ffi.ffi_call(target, out_shape)(*args, **attrs)
+            return call
+
+        fn = make(target)
+        fn.__name__ = py_name
+        setattr(ns, py_name, fn)
+    return ns
