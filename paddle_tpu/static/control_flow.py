@@ -1,0 +1,145 @@
+"""Control-flow ops: cond / while_loop / switch_case / case.
+
+Reference analog: paddle/fluid/operators/controlflow/
+(conditional_block_op.cc, while_op.cc) surfaced as
+python/paddle/static/nn/control_flow.py (cond:392, While/while_loop:1049,
+switch_case:1211) and converted from python syntax by
+jit/dy2static/program_translator.py:1225.
+
+TPU-native tracing contract (replaces the dy2static AST rewrite):
+
+- EAGER (concrete predicate): plain python dispatch — only the taken
+  branch runs, autograd flows through the tape exactly like any op.
+- TRACED (predicate is a jax tracer, i.e. inside ``to_static``/``jit``):
+  lowers to ``lax.cond`` / ``lax.while_loop`` / ``lax.switch``. Both
+  branches are traced (XLA compiles both; one executes), so branch
+  outputs must match in structure/shape/dtype. ``cond``/``switch_case``
+  differentiate through jax autodiff; ``while_loop`` is
+  forward-differentiable only (XLA's while has no reverse-mode
+  transpose — same contract as jax; use a bounded loop or ``lax.scan``
+  patterns when you need gradients).
+
+Data-dependent python ``if x > 0:`` on a traced Tensor raises jax's
+TracerBoolConversionError — rewrite it with these ops, which is the same
+contract the reference enforces in static graphs (python ``if`` on a
+Variable silently takes one branch there; dy2static exists to rewrite
+it to cond). Here the error is loud instead of silent.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _is_traced(a):
+    from jax.core import Tracer
+    return isinstance(a, Tracer)
+
+
+def _to_arrays(tree):
+    return jax.tree_util.tree_map(
+        lambda t: _arr(t), tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _to_tensors(tree):
+    return jax.tree_util.tree_map(Tensor, tree)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
+         return_names=None):
+    """Run ``true_fn()`` if pred else ``false_fn()``
+    (reference static/nn/control_flow.py:392)."""
+    p = _arr(pred)
+    if not _is_traced(p):
+        return true_fn() if bool(p) else false_fn()
+
+    def wrap(fn):
+        return lambda: _to_arrays(fn())
+
+    out = lax.cond(p, wrap(true_fn), wrap(false_fn))
+    return _to_tensors(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence, is_test=False, name=None):
+    """Iterate ``body_fn(*vars)`` while ``cond_fn(*vars)``
+    (reference static/nn/control_flow.py:1049)."""
+    arrs = [_arr(v) for v in loop_vars]
+    traced = any(map(_is_traced, arrs)) or _is_traced(_arr(
+        cond_fn(*loop_vars)))
+    if not traced:
+        vals = list(loop_vars)
+        while bool(_arr(cond_fn(*vals))):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (tuple, list)) else [out]
+        return vals
+
+    def acond(carry):
+        return _arr(cond_fn(*_to_tensors(list(carry))))
+
+    def abody(carry):
+        out = body_fn(*_to_tensors(list(carry)))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(_to_arrays(list(out)))
+
+    out = lax.while_loop(acond, abody, tuple(arrs))
+    return _to_tensors(list(out))
+
+
+def switch_case(branch_index, branch_fns: Union[Dict, List, tuple],
+                default: Callable = None, name=None):
+    """Dispatch on an integer index with an optional default
+    (reference static/nn/control_flow.py:1211)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [int(k) for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]  # reference: last branch doubles as default
+
+    idx = _arr(branch_index)
+    if not _is_traced(idx):
+        i = int(idx)
+        return dict(zip(keys, fns)).get(i, default)()
+
+    karr = jnp.asarray(keys)
+    matches = karr == idx.astype(karr.dtype)
+    sel = jnp.where(jnp.any(matches), jnp.argmax(matches), len(fns))
+    branches = [(lambda f: (lambda: _to_arrays(f())))(f)
+                for f in fns + [default]]
+    return _to_tensors(lax.switch(sel, branches))
+
+
+def case(pred_fn_pairs: Sequence, default: Callable = None, name=None):
+    """First pair whose predicate holds wins
+    (reference static/nn/control_flow.py case). Builds nested cond, so it
+    works traced as well as eager."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+
+    def build(pairs):
+        if not pairs:
+            return default
+        (p, f), rest = pairs[0], pairs[1:]
+        return lambda: cond(p, f, build(rest))
+
+    return build(list(pred_fn_pairs))()

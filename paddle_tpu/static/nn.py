@@ -7,8 +7,10 @@ from __future__ import annotations
 
 from ..nn import functional as F
 from ..nn import Linear, Conv2D, BatchNorm, Embedding
+from .control_flow import cond, while_loop, switch_case, case
 
-__all__ = ["fc", "conv2d", "batch_norm", "embedding"]
+__all__ = ["fc", "conv2d", "batch_norm", "embedding",
+           "cond", "while_loop", "switch_case", "case"]
 
 
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,

@@ -1,0 +1,109 @@
+"""Control-flow ops: eager dispatch + traced lowering to lax.cond /
+while_loop / switch, with gradients through cond.
+
+Reference test pattern: test_cond.py / test_while_loop.py
+(fluid/tests/unittests) — same fn run eager and static, outputs equal."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def test_cond_eager():
+    x = paddle.to_tensor(3.0)
+    out = snn.cond(x < 5.0, lambda: x * 2, lambda: x - 1)
+    assert float(out.numpy()) == 6.0
+    out = snn.cond(x > 5.0, lambda: x * 2, lambda: x - 1)
+    assert float(out.numpy()) == 2.0
+
+
+def test_cond_traced_and_grad():
+    @paddle.jit.to_static
+    def f(x):
+        return snn.cond(x.sum() > 0,
+                        lambda: x * 2.0,
+                        lambda: -x)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(f(x).numpy(), [2.0, 4.0])
+    x2 = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(x2).numpy(), [1.0, 2.0])
+
+    # gradients flow through the traced cond (lax.cond vjp)
+    g = jax.grad(lambda a: float_free(a))(jnp.array([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])
+    g2 = jax.grad(lambda a: float_free(a))(jnp.array([-1.0, -2.0]))
+    np.testing.assert_allclose(np.asarray(g2), [-1.0, -1.0])
+
+
+def float_free(a):
+    from paddle_tpu.static.control_flow import cond
+    out = cond(jnp.sum(a) > 0, lambda: a * 2.0, lambda: -a)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return jnp.sum(leaf._array if hasattr(leaf, "_array") else leaf)
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(0)
+    s = paddle.to_tensor(0.0)
+    i2, s2 = snn.while_loop(lambda i, s: i < 5,
+                            lambda i, s: (i + 1, s + 2.0), [i, s])
+    assert int(i2.numpy()) == 5 and float(s2.numpy()) == 10.0
+
+
+def test_while_loop_traced():
+    @paddle.jit.to_static
+    def f(n):
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(1.0)
+        i, s = snn.while_loop(lambda i, s: i < n,
+                              lambda i, s: (i + 1, s * 2.0), [i, s])
+        return s
+
+    out = f(paddle.to_tensor(6))
+    assert float(out.numpy()) == 64.0
+
+
+def test_switch_case_eager_and_default():
+    fns = {1: lambda: paddle.to_tensor(10.0),
+           3: lambda: paddle.to_tensor(30.0)}
+    d = lambda: paddle.to_tensor(-1.0)  # noqa: E731
+    assert float(snn.switch_case(paddle.to_tensor(3), fns, d).numpy()) == 30.0
+    assert float(snn.switch_case(paddle.to_tensor(7), fns, d).numpy()) == -1.0
+
+
+def test_switch_case_traced():
+    @paddle.jit.to_static
+    def f(idx):
+        return snn.switch_case(
+            idx,
+            {0: lambda: paddle.to_tensor(0.0),
+             2: lambda: paddle.to_tensor(22.0)},
+            default=lambda: paddle.to_tensor(99.0))
+
+    assert float(f(paddle.to_tensor(2)).numpy()) == 22.0
+    assert float(f(paddle.to_tensor(5)).numpy()) == 99.0
+
+
+def test_case_first_match_wins():
+    x = paddle.to_tensor(2.0)
+    out = snn.case([(x > 3.0, lambda: paddle.to_tensor(1.0)),
+                    (x > 1.0, lambda: paddle.to_tensor(2.0))],
+                   default=lambda: paddle.to_tensor(0.0))
+    assert float(out.numpy()) == 2.0
+
+
+def test_python_if_on_traced_tensor_raises():
+    """The documented tracing contract: data-dependent python `if` fails
+    loudly under to_static instead of silently picking a branch."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:  # python bool on a tracer
+            return x
+        return -x
+
+    with pytest.raises(Exception):
+        f(paddle.to_tensor(np.array([1.0], np.float32)))
