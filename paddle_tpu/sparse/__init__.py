@@ -2,32 +2,88 @@
 
 Reference analog: python/paddle/sparse/ over phi SparseCooTensor/
 SparseCsrTensor kernels (paddle/phi/core/sparse_coo_tensor.h,
-kernels/sparse/ 14k LoC). TPU-native: jax.experimental.sparse BCOO is the
-backing representation (XLA lowers scatter/gather-based spmm); dense
-round-trips are exact. Covers the creation + conversion + elementwise +
-matmul surface of the reference's paddle.sparse.
+kernels/sparse/ 14k LoC).
+
+TPU-native: jax.experimental.sparse BCOO is the backing representation —
+XLA lowers spmm/sddmm to gather/scatter + MXU dots. The dense form is
+materialized ONLY when explicitly requested (``to_dense()``/``numpy()``
+or dense-only Tensor methods): creation, unary ops, add/sub/mul,
+matmul, masked_matmul (true SDDMM: gather + batched dot, never the full
+product), transpose/reshape/coalesce/sum and the sparse softmax all stay
+on the (values, indices) representation.
 """
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor",
            "is_same_shape", "add", "subtract", "multiply", "divide",
            "matmul", "relu", "tanh", "sqrt", "sin", "abs", "pow", "neg",
            "cast", "transpose", "sum", "coalesce", "mask_as",
            "masked_matmul", "mv", "addmm", "reshape", "nn"]
 
+# the member descriptor for Tensor's `_array` slot: SparseCooTensor
+# shadows it with a lazy property so constructing/operating on sparse
+# tensors never materializes the dense form until something asks for it
+_ARRAY_SLOT = Tensor.__dict__["_array"]
+
 
 class SparseCooTensor(Tensor):
-    """Tensor wrapper over a BCOO array; .indices()/.values()/to_dense()."""
+    """Tensor face over a BCOO array; .indices()/.values()/to_dense().
+    Dense materialization is lazy (first `_array` access) and cached."""
 
     def __init__(self, bcoo, stop_gradient=True):
         self._bcoo = bcoo
-        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+        super().__init__(None, stop_gradient=stop_gradient)
+
+    @property
+    def _array(self):
+        val = _ARRAY_SLOT.__get__(self)
+        if val is None:
+            val = self._bcoo.todense()
+            _ARRAY_SLOT.__set__(self, val)
+        return val
+
+    @_array.setter
+    def _array(self, v):
+        _ARRAY_SLOT.__set__(self, v)
+
+    # metadata must not densify
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def ndim(self):
+        return self._bcoo.ndim
+
+    @property
+    def rank(self):
+        return self._bcoo.ndim
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._bcoo.shape:
+            n *= int(s)
+        return n
+
+    def __len__(self):
+        return int(self._bcoo.shape[0])
+
+    def __bool__(self):
+        raise ValueError(
+            "truth value of a sparse tensor is ambiguous; use to_dense()")
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
 
     def indices(self):
         return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
@@ -44,9 +100,39 @@ class SparseCooTensor(Tensor):
     def is_sparse_coo(self):
         return True
 
+    def is_sparse_csr(self):
+        return False
+
     @property
     def nnz(self):
         return self._bcoo.nse
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR face (reference phi SparseCsrTensor): keeps crows/cols/values
+    accessors; compute rides the same BCOO backing (COO<->CSR is a row
+    expansion, free at trace time on TPU where both lower to gathers)."""
+
+    def __init__(self, bcoo, crows, cols, vals, stop_gradient=True):
+        super().__init__(bcoo, stop_gradient=stop_gradient)
+        self._crows = crows
+        self._cols = cols
+        self._vals = vals
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._vals)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
@@ -67,14 +153,22 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    # represent CSR via COO (BCOO backing); row expansion on host
-    crows_np = np.asarray(crows._array if isinstance(crows, Tensor)
-                          else crows)
-    cols_np = np.asarray(cols._array if isinstance(cols, Tensor) else cols)
-    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    indices = np.stack([rows, cols_np])
-    return sparse_coo_tensor(indices, values, shape, dtype, place,
-                             stop_gradient)
+    crows_a = jnp.asarray(np.asarray(
+        crows._array if isinstance(crows, Tensor) else crows))
+    cols_a = jnp.asarray(np.asarray(
+        cols._array if isinstance(cols, Tensor) else cols))
+    vals_a = values._array if isinstance(values, Tensor) \
+        else jnp.asarray(np.asarray(values))
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals_a = vals_a.astype(convert_dtype(dtype))
+    rows = np.repeat(np.arange(len(crows_a) - 1),
+                     np.diff(np.asarray(crows_a)))
+    idx_t = jnp.stack([jnp.asarray(rows, jnp.int32),
+                       cols_a.astype(jnp.int32)], axis=1)
+    bcoo = jsparse.BCOO((vals_a, idx_t), shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(bcoo, crows_a, cols_a, vals_a,
+                           stop_gradient=stop_gradient)
 
 
 def _sparse_unary(name, fn):
@@ -111,26 +205,69 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
     return Tensor(x._array.astype(convert_dtype(value_dtype)))
 
 
-def _binop(name, fn):
-    def op(x, y, name=None):
-        xd = x.to_dense()._array if isinstance(x, SparseCooTensor) \
-            else x._array
-        yd = y.to_dense()._array if isinstance(y, SparseCooTensor) \
-            else y._array
-        dense = fn(xd, yd)
-        idx = jnp.stack(jnp.nonzero(dense, size=None))
-        return Tensor(dense)
-    op.__name__ = name
-    return op
+def _lincomb(x, y, negate_y):
+    """x +/- y for sparse operands without densifying: concatenate the
+    two index/value sets and merge duplicates (the phi sparse
+    elementwise-add kernel's strategy)."""
+    bx, by = x._bcoo, y._bcoo
+    ydata = by.data.astype(bx.data.dtype)
+    if negate_y:
+        ydata = jnp.negative(ydata)  # dtype-preserving (ints stay ints)
+    data = jnp.concatenate([bx.data, ydata])
+    idx = jnp.concatenate([bx.indices, by.indices])
+    out = jsparse.bcoo_sum_duplicates(
+        jsparse.BCOO((data, idx), shape=bx.shape))
+    return SparseCooTensor(out)
 
 
-add = _binop("add", jnp.add)
-subtract = _binop("subtract", jnp.subtract)
-multiply = _binop("multiply", jnp.multiply)
-divide = _binop("divide", jnp.true_divide)
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _lincomb(x, y, False)
+    return Tensor(x._array + y._array)
+
+
+def subtract(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _lincomb(x, y, True)
+    return Tensor(x._array - y._array)
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = jsparse.bcoo_multiply_sparse(x._bcoo, y._bcoo)
+        return SparseCooTensor(out)
+    if isinstance(x, SparseCooTensor):
+        # bcoo_multiply_dense returns the new DATA vector (length nse);
+        # rebuild on x's pattern
+        data = jsparse.bcoo_multiply_dense(x._bcoo, y._array)
+        return SparseCooTensor(jsparse.BCOO(
+            (data, x._bcoo.indices), shape=x._bcoo.shape))
+    return Tensor(x._array * y._array)
+
+
+def divide(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and not isinstance(
+            y, SparseCooTensor):
+        data = jsparse.bcoo_multiply_dense(x._bcoo, 1.0 / y._array)
+        return SparseCooTensor(jsparse.BCOO(
+            (data, x._bcoo.indices), shape=x._bcoo.shape))
+    if isinstance(x, SparseCooTensor):
+        # sparse/sparse divides stored values, defined only when both
+        # operands share one sparsity pattern — verify, loudly
+        bx = jsparse.bcoo_sum_duplicates(x._bcoo)
+        by = jsparse.bcoo_sum_duplicates(y._bcoo)
+        if bx.nse != by.nse or not bool(
+                jnp.array_equal(bx.indices, by.indices)):
+            raise NotImplementedError(
+                "sparse/sparse divide requires identical sparsity "
+                "patterns; densify one operand instead")
+        return SparseCooTensor(jsparse.BCOO(
+            (bx.data / by.data, bx.indices), shape=bx.shape))
+    return Tensor(x._array / y._array)
 
 
 def matmul(x, y, name=None):
+    """spmm: sparse @ dense -> dense (XLA gather/scatter lowering)."""
     if isinstance(x, SparseCooTensor):
         out = x._bcoo @ (y._array if isinstance(y, Tensor) else y)
         return Tensor(out)
@@ -145,8 +282,22 @@ def transpose(x, perm, name=None):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
-    xd = x.to_dense()._array if isinstance(x, SparseCooTensor) else x._array
-    return Tensor(jnp.sum(xd, axis=axis, keepdims=keepdim))
+    """Reduce over stored values — implicit zeros contribute nothing, so
+    no densification (reference sparse sum kernel)."""
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        if axis is None:
+            out = jnp.sum(b.data)
+            return Tensor(out.reshape((1,) * b.ndim) if keepdim else out)
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(a % b.ndim for a in axes)
+        red = jsparse.bcoo_reduce_sum(b, axes=axes)
+        t = SparseCooTensor(red, stop_gradient=x.stop_gradient)
+        if keepdim:
+            shp = [1 if i in axes else s for i, s in enumerate(b.shape)]
+            return reshape(t, shp)
+        return t
+    return Tensor(jnp.sum(x._array, axis=axis, keepdims=keepdim))
 
 
 def is_same_shape(x, y):
@@ -163,8 +314,7 @@ def coalesce(x, name=None):
 
 def mask_as(x, mask, name=None):
     """Keep only the entries of dense `x` at `mask`'s sparsity pattern
-    (reference: python/paddle/sparse/unary.py mask_as /
-    sparse_mask)."""
+    (reference: python/paddle/sparse/unary.py mask_as / sparse_mask)."""
     assert isinstance(mask, SparseCooTensor)
     xd = x._array if isinstance(x, Tensor) else jnp.asarray(x)
     b = mask._bcoo
@@ -175,11 +325,17 @@ def mask_as(x, mask, name=None):
 
 
 def masked_matmul(x, y, mask, name=None):
-    """(x @ y) sampled at mask's pattern — SDDMM
-    (reference: python/paddle/sparse/binary.py masked_matmul)."""
+    """(x @ y) sampled at mask's pattern — true SDDMM: gather the needed
+    rows/cols and take per-nse dots; the dense product is never formed
+    (reference: python/paddle/sparse/binary.py masked_matmul → phi
+    sddmm/csr kernels)."""
     xd = x._array if isinstance(x, Tensor) else jnp.asarray(x)
     yd = y._array if isinstance(y, Tensor) else jnp.asarray(y)
-    return mask_as(Tensor(jnp.matmul(xd, yd)), mask)
+    b = mask._bcoo
+    assert b.ndim == 2 and xd.ndim == 2 and yd.ndim == 2
+    i, j = b.indices[:, 0], b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[i, :], yd[:, j].T)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
 
 
 def mv(x, vec, name=None):
@@ -193,9 +349,7 @@ def mv(x, vec, name=None):
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
     """beta*input + alpha*(x @ y) with sparse x
     (reference: python/paddle/sparse/binary.py addmm)."""
-    inp = input.to_dense()._array if isinstance(input, SparseCooTensor) \
-        else (input._array if isinstance(input, Tensor)
-              else jnp.asarray(input))
+    inp = input._array if isinstance(input, Tensor) else jnp.asarray(input)
     prod = matmul(x, y)._array
     return Tensor(beta * inp + alpha * prod)
 
@@ -215,29 +369,29 @@ class _SparseReLU:
 
 
 class _SparseSoftmax:
-    """Softmax over the STORED entries of each row (the sparsity pattern
-    comes from the indices, so explicitly-stored zeros participate —
-    reference: python/paddle/sparse/nn/layer/activation.py Softmax)."""
+    """Softmax over the STORED entries of each row — segment-reduced on
+    the values, no densification (reference:
+    python/paddle/sparse/nn/layer/activation.py Softmax over the csr
+    row-wise kernel)."""
 
     def __init__(self, axis=-1):
         self.axis = axis
 
     def __call__(self, x):
-        import jax
         if isinstance(x, SparseCooTensor):
             b = jsparse.bcoo_sum_duplicates(x._bcoo)
-            pattern = jnp.zeros(b.shape, bool).at[
-                tuple(b.indices[:, d] for d in range(b.indices.shape[1]))
-            ].set(True)
-            d = b.todense()
-            neg_inf = jnp.where(pattern, d, -jnp.inf)
-            sm = jax.nn.softmax(neg_inf, axis=self.axis)
-            vals = sm[tuple(b.indices[:, d2]
-                            for d2 in range(b.indices.shape[1]))]
+            if b.ndim != 2 or self.axis not in (-1, 1):
+                raise NotImplementedError(
+                    "sparse softmax: 2-D over the last axis")
+            rows = b.indices[:, 0]
+            R = b.shape[0]
+            m = jax.ops.segment_max(b.data, rows, num_segments=R)
+            e = jnp.exp(b.data - m[rows])
+            s = jax.ops.segment_sum(e, rows, num_segments=R)
+            vals = e / s[rows]
             return SparseCooTensor(
                 jsparse.BCOO((vals, b.indices), shape=b.shape),
                 stop_gradient=x.stop_gradient)
-        import jax.nn
         return Tensor(jax.nn.softmax(x._array, axis=self.axis))
 
 
