@@ -25,7 +25,9 @@ from . import checkpoint
 from . import pipeline
 from . import rpc
 from . import auto_parallel
-from .launch_utils import spawn, launch
+from .launch_utils import spawn
+from . import launch
+from . import ps
 
 __all__ = [
     "ReduceOp", "Group", "new_group", "get_group", "all_reduce",

@@ -6,16 +6,15 @@ paddle.distributed.spawn.
 
 On TPU the unit of launch is one process per HOST (all local chips belong
 to one jax client), so `spawn` with nprocs>1 on one host is only meaningful
-for CPU-mesh testing; `launch` execs the training script once per host with
-coordinator env wired for jax.distributed.initialize.
+for CPU-mesh testing. The pod launcher (per-rank logs, TCPStore rendezvous
+env, gang restart) lives in distributed.launch.
 """
 from __future__ import annotations
 
 import multiprocessing as mp
 import os
-import sys
 
-__all__ = ["spawn", "launch"]
+__all__ = ["spawn"]
 
 
 def _spawn_target(fn, rank, nprocs, env, args):
@@ -46,19 +45,3 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
                 raise RuntimeError(
                     f"spawned rank failed with exit code {p.exitcode}")
     return procs
-
-
-def launch():
-    """python -m paddle_tpu.distributed.launch <script> parity."""
-    argv = sys.argv[1:]
-    if not argv:
-        print("usage: python -m paddle_tpu.distributed.launch script.py "
-              "[args...]")
-        return 1
-    script = argv[0]
-    sys.argv = argv
-    with open(script) as f:
-        code = compile(f.read(), script, "exec")
-    globs = {"__name__": "__main__", "__file__": script}
-    exec(code, globs)
-    return 0

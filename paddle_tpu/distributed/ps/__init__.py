@@ -1,0 +1,54 @@
+"""Parameter-server training: explicit scope-out (SURVEY §2.5 #10).
+
+Reference: paddle/fluid/distributed/ps/ (~40k LoC: brpc-based
+PsService, DownpourBrpcPs tables, dense/sparse table shards, geo-async
+SGD) surfaced as fleet's ParameterServerOptimizer
+(python/paddle/distributed/fleet/meta_optimizers/ps_optimizer.py) and
+the CPU "heter" trainers.
+
+Decision: OUT OF SCOPE for the TPU framework, by design rather than
+omission.
+
+Why:
+- The PS stack exists to scale sparse embedding tables beyond
+  accelerator memory on CPU clusters with asynchronous updates. On TPU
+  pods the same workload maps onto synchronous SPMD: embedding tables
+  shard over the mesh ('mp'/'dp' axes, e.g. models.llama vocab-parallel
+  embedding), lookups are XLA all-to-all/gather collectives over ICI,
+  and optimizer state shards with ZeRO (distributed/sharding). The
+  100B-feature / trillion-parameter claims the reference makes for PS
+  (README "Ultra-Large-Scale Training") are reached on TPU by adding
+  hosts to the mesh, not by a side channel of CPU parameter servers.
+- Asynchronous/geo-async SGD semantics conflict with the deterministic
+  synchronous step this framework compiles (one jit'd update over a
+  mesh); supporting them would fork the execution model for a hardware
+  profile (loose CPU clusters + RPC) that TPU deployments do not have.
+- The remaining PS use case — streaming recommender models with
+  out-of-accelerator-memory embeddings — needs a DCN-sharded embedding
+  service. That is deliverable as a separate service in front of this
+  framework (host-RAM embedding shards + device dense towers), and the
+  extension points it needs already exist: distributed.rpc for the
+  fetch/push plane and utils.cpp_extension's XLA FFI host ops for the
+  lookup kernels.
+
+The symbols below raise with this explanation so fleet configs that
+request PS fail loudly with the migration path instead of silently
+training without it.
+"""
+from __future__ import annotations
+
+__all__ = ["ParameterServerOptimizer", "is_supported"]
+
+_MSG = ("parameter-server training is out of scope on the TPU stack: "
+        "shard embeddings over the mesh instead (see "
+        "paddle_tpu.distributed.ps docstring for the rationale and "
+        "migration path)")
+
+
+def is_supported() -> bool:
+    return False
+
+
+class ParameterServerOptimizer:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)

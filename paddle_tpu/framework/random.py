@@ -27,12 +27,19 @@ class Generator:
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(int(seed))
+        # key creation is deferred to first use: PRNGKey executes a jax
+        # computation, and the module-level default_generator must not
+        # touch a device at import time (e.g. `python -m
+        # paddle_tpu.distributed.launch` on a host whose accelerator
+        # plugin is unavailable)
+        self._key = None
         self._counter = 0
         return self
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
             self._counter += 1
             return jax.random.fold_in(self._key, self._counter)
 
@@ -41,7 +48,7 @@ class Generator:
 
     def set_state(self, state):
         self._seed, self._counter = state
-        self._key = jax.random.PRNGKey(int(self._seed))
+        self._key = None
         return self
 
     def initial_seed(self):

@@ -1,0 +1,83 @@
+"""Launcher: pod spawn, per-rank logs, env contract, gang restart after
+killing a worker.
+
+Reference test pattern: test_launch_coverage.py / test_run.py
+(fluid/tests/unittests: run the launch module against a toy script,
+assert logs + restart behavior)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT_OK = """
+import os, sys
+rank = os.environ["PADDLE_TRAINER_ID"]
+n = os.environ["PADDLE_TRAINERS_NUM"]
+master = os.environ["PADDLE_MASTER"]
+print(f"rank={rank} n={n} master={master} ok", flush=True)
+"""
+
+_SCRIPT_KILL_ONE = """
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+print(f"start rank={rank} restart={restart}", flush=True)
+if rank == 1 and restart == 0:
+    os._exit(17)  # simulate a crashed worker on the first round
+print(f"done rank={rank} restart={restart}", flush=True)
+"""
+
+
+def _run_launch(tmp_path, script_body, nproc=3, max_restarts=2):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(script_body))
+    log_dir = tmp_path / "log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(nproc), "--log_dir", str(log_dir),
+         "--max_restarts", str(max_restarts), str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    return proc, log_dir
+
+
+def test_launch_env_and_logs(tmp_path):
+    proc, log_dir = _run_launch(tmp_path, _SCRIPT_OK)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for rank in range(3):
+        log = (log_dir / f"workerlog.{rank}").read_text()
+        assert f"rank={rank} n=3" in log
+        assert "master=127.0.0.1:" in log and " ok" in log
+
+
+def test_launch_gang_restart_after_worker_death(tmp_path):
+    proc, log_dir = _run_launch(tmp_path, _SCRIPT_KILL_ONE)
+    assert proc.returncode == 0, (proc.stderr[-2000:],)
+    assert "gang restart 1/2" in proc.stderr
+    # round 0: rank 1 died; round 1: everyone finished
+    log1 = (log_dir / "workerlog.1").read_text()
+    assert "start rank=1 restart=0" in log1
+    assert "done rank=1 restart=1" in log1
+    log0 = (log_dir / "workerlog.0").read_text()
+    assert "done rank=0 restart=1" in log0
+
+
+def test_launch_exhausts_restart_budget(tmp_path):
+    proc, _ = _run_launch(tmp_path, """
+import os
+os._exit(9)
+""", nproc=2, max_restarts=1)
+    assert proc.returncode == 9
+    assert "giving up" in proc.stderr
+
+
+def test_ps_scope_out_raises():
+    from paddle_tpu.distributed import ps
+    assert not ps.is_supported()
+    with pytest.raises(NotImplementedError, match="out of scope"):
+        ps.ParameterServerOptimizer()
