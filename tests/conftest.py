@@ -34,3 +34,20 @@ def _seed():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+# smoke/slow tiers: `pytest -m "not slow" tests/` is the fast signal
+# while iterating; the full suite is the merge gate. Modules listed here
+# spend most of their time in XLA compiles of multi-device meshes or
+# whole model zoos.
+_SLOW_MODULES = {
+    "test_graft_entry", "test_pipeline_1f1b", "test_distributed_checkpoint",
+    "test_e2e_training", "test_vision_models", "test_auto_parallel",
+    "test_jit_inference", "test_launch",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module and item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
