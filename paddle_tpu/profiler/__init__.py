@@ -19,6 +19,12 @@ import jax
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "export_chrome_tracing", "benchmark"]
 
+# host-span aggregation for the summary stats table (reference:
+# profiler/profiler_statistic.py — EventSummary/statistic_data tables).
+# RecordEvent feeds every ACTIVE profiler's own stats dict, so
+# concurrent Profiler instances don't clobber each other.
+_ACTIVE_PROFILERS: list = []
+
 
 class ProfilerTarget(Enum):
     CPU = 0
@@ -69,15 +75,24 @@ class RecordEvent:
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def begin(self):
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        self._t0 = time.perf_counter()
 
     def end(self):
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._t0 is not None and _ACTIVE_PROFILERS:
+            dt = time.perf_counter() - self._t0
+            for p in _ACTIVE_PROFILERS:
+                stats = p._span_stats
+                calls, total, mx = stats.get(self.name, (0, 0.0, 0.0))
+                stats[self.name] = (calls + 1, total + dt, max(mx, dt))
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -105,6 +120,7 @@ class Profiler:
         self._state = ProfilerState.CLOSED
         self._active = False
         self._step_times = []
+        self._span_stats: dict = {}
         self._last = None
 
     def start(self):
@@ -115,12 +131,17 @@ class Profiler:
                 and not self._timer_only:
             jax.profiler.start_trace(self._log_dir)
             self._active = True
+        self._span_stats.clear()
+        if self not in _ACTIVE_PROFILERS:
+            _ACTIVE_PROFILERS.append(self)
         self._last = time.perf_counter()
 
     def stop(self):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+        if self in _ACTIVE_PROFILERS:
+            _ACTIVE_PROFILERS.remove(self)
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -154,9 +175,38 @@ class Profiler:
         return (f"avg step: {arr.mean() * 1000:.2f} ms, "
                 f"ips: {1.0 / max(arr.mean(), 1e-9):.2f} steps/s")
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+    def summary_table(self, sorted_by="total", time_unit="ms") -> str:
+        """Host-span stats table (reference:
+        profiler_statistic.py _build_table): name / calls / total / avg /
+        max / % of wall."""
+        units = {"s": 1.0, "ms": 1e3, "us": 1e6, "ns": 1e9}
+        unit = units.get(time_unit, 1e3)
+        if time_unit not in units:
+            time_unit = "ms"
+        wall = sum(self._step_times) or sum(
+            t for _, t, _ in self._span_stats.values()) or 1e-12
+        rows = [(name, c, tot, tot / c, mx)
+                for name, (c, tot, mx) in self._span_stats.items()]
+        key = {"total": 2, "calls": 1, "avg": 3, "max": 4}.get(sorted_by, 2)
+        rows.sort(key=lambda r: -r[key])
+        header = (f"{'Name':<32}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                  f"{'Avg(' + time_unit + ')':>12}"
+                  f"{'Max(' + time_unit + ')':>12}{'Ratio%':>8}")
+        lines = ["-" * len(header), header, "-" * len(header)]
+        for name, c, tot, avg, mx in rows:
+            lines.append(
+                f"{name[:32]:<32}{c:>8}{tot * unit:>14.3f}"
+                f"{avg * unit:>12.3f}{mx * unit:>12.3f}"
+                f"{100.0 * tot / wall:>8.1f}")
+        lines.append("-" * len(header))
+        if self._step_times:
+            lines.append(self.step_info())
+        return "\n".join(lines)
+
+    def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        print(self.step_info(), flush=True)
+        print(self.summary_table(sorted_by=sorted_by if isinstance(
+            sorted_by, str) else "total", time_unit=time_unit), flush=True)
 
     def __enter__(self):
         self.start()
@@ -168,10 +218,12 @@ class Profiler:
 
 
 class benchmark:
-    """reference: profiler/timer.py — ips reporting helper."""
+    """reference: profiler/timer.py (Benchmark.step_info — reader-cost +
+    ips over a moving window)."""
 
     def __init__(self):
         self._times = []
+        self._samples = []
         self._last = None
 
     def begin(self):
@@ -181,6 +233,7 @@ class benchmark:
         now = time.perf_counter()
         if self._last is not None:
             self._times.append(now - self._last)
+            self._samples.append(num_samples or 1)
         self._last = now
 
     def end(self):
@@ -189,4 +242,8 @@ class benchmark:
     def report(self):
         import numpy as np
         arr = np.asarray(self._times or [0.0])
-        return {"avg_s": float(arr.mean()), "steps": len(self._times)}
+        n = float(np.sum(self._samples)) if self._samples else 0.0
+        total = float(np.sum(arr)) or 1e-12
+        return {"avg_s": float(arr.mean()), "steps": len(self._times),
+                "ips": n / total,
+                "steps_per_sec": len(self._times) / total}

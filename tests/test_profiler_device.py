@@ -1,0 +1,80 @@
+"""Profiler stats tables + ips timer, and the paddle.device runtime
+surface (streams/events/memory stats).
+
+Reference analog: python/paddle/profiler/profiler_statistic.py
+(_build_table summary), profiler/timer.py (Benchmark ips), and
+paddle/fluid/pybind/cuda_streams_py.cc (Stream/Event surface)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+
+
+def test_record_event_stats_and_summary_table():
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        with prof.RecordEvent("forward"):
+            time.sleep(0.002)
+        with prof.RecordEvent("backward"):
+            time.sleep(0.004)
+        p.step()
+    p.stop()
+    table = p.summary_table()
+    lines = [ln for ln in table.splitlines()
+             if ln.startswith(("forward", "backward"))]
+    assert len(lines) == 2
+    # backward is slower → sorted first by total
+    assert table.index("backward") < table.index("forward")
+    assert " 3" in lines[0]  # call counts
+    info = p.step_info()
+    assert "ips" in info and "avg step" in info
+
+
+def test_make_scheduler_state_machine():
+    sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(4)]
+    assert states[0] == prof.ProfilerState.CLOSED
+    assert states[1] == prof.ProfilerState.READY
+    assert states[2] == prof.ProfilerState.RECORD
+    assert states[3] == prof.ProfilerState.RECORD_AND_RETURN
+
+
+def test_benchmark_ips_counts_samples():
+    b = prof.benchmark()
+    b.begin()
+    for _ in range(5):
+        time.sleep(0.001)
+        b.step(num_samples=32)
+    b.end()
+    r = b.report()
+    assert r["steps"] == 5
+    assert r["ips"] > r["steps_per_sec"]  # 32 samples per step
+    np.testing.assert_allclose(r["ips"], 32 * r["steps_per_sec"],
+                               rtol=1e-6)
+
+
+def test_device_surface():
+    dev = paddle.device
+    assert dev.get_all_device_type()
+    assert dev.device_count() >= 1
+    dev.synchronize()
+
+    s = dev.cuda.current_stream()
+    e1, e2 = dev.Event(), dev.Event()
+    e1.record(s)
+    time.sleep(0.002)
+    e2.record(s)
+    assert e2.elapsed_time(e1) < 0 < e1.elapsed_time(e2)
+    s.synchronize()
+
+    # memory stats: CPU PJRT may not implement them; the API must still
+    # return integers, and after allocating they are monotone
+    a0 = dev.cuda.memory_allocated()
+    assert isinstance(a0, int) and a0 >= 0
+    keep = paddle.ones([256, 256])
+    assert dev.cuda.max_memory_allocated() >= 0
+    del keep
