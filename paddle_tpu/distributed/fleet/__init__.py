@@ -19,6 +19,7 @@ from ..parallel import init_parallel_env, DataParallel
 from ..collective import get_rank, get_world_size
 from . import mp_layers
 from . import utils
+from . import elastic
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
                         RowParallelLinear, ParallelCrossEntropy)
 from .. import auto_parallel as auto  # `from fleet import auto` parity

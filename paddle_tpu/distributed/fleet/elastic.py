@@ -1,0 +1,99 @@
+"""Failure detection: heartbeats + staleness monitor.
+
+Reference analog: python/paddle/distributed/fleet/elastic/manager.py:126
+(ElasticManager — etcd-registered node heartbeats, a watchdog that
+declares nodes dead and triggers pod restart). Scale-in/scale-out
+membership changes are out of scope for now; what this provides is the
+failure-detection half: process EXITS are caught by the launcher's
+poll-based watchdog, and in-process HANGS are caught here through
+heartbeat staleness.
+
+TPU-native shape: heartbeats ride the same native TCPStore the launcher
+already serves for rendezvous (csrc/tcp_store.cc) — no etcd. Each beat
+is a counter increment; the monitor compares counter *changes* against
+its own clock, so worker/launcher clock skew cannot cause false
+positives. Workers opt in by calling ``start_heartbeat()`` (typically
+right after init_parallel_env); ranks that never beat are not monitored,
+so scripts that don't cooperate simply keep exit-code-only supervision.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["start_heartbeat", "HeartbeatMonitor"]
+
+
+def _hb_key(job_id: str, restart: str, rank: str) -> str:
+    return f"hb/{job_id}/{restart}/{rank}"
+
+
+def start_heartbeat(interval: float = 2.0, store=None) -> threading.Event:
+    """Worker side: beat into the job's TCPStore from a daemon thread.
+    Env contract comes from the launcher (PADDLE_MASTER / PADDLE_JOB_ID /
+    PADDLE_TRAINER_ID / PADDLE_RESTART_COUNT). Returns a stop Event."""
+    if store is None:
+        from ..store import TCPStore
+        host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=False, timeout=60)
+    key = _hb_key(os.environ.get("PADDLE_JOB_ID", "default"),
+                  os.environ.get("PADDLE_RESTART_COUNT", "0"),
+                  os.environ.get("PADDLE_TRAINER_ID", "0"))
+    stop = threading.Event()
+
+    # one synchronous beat before the thread starts: the rank is
+    # monitored from the moment start_heartbeat returns, even if it
+    # hangs (or the scheduler starves the thread) immediately after
+    store.add(key, 1)
+
+    def beat():
+        while not stop.is_set():
+            stop.wait(interval)
+            try:
+                store.add(key, 1)
+            except Exception:
+                return  # store gone: the pod is coming down anyway
+
+    threading.Thread(target=beat, daemon=True,
+                     name="paddle-tpu-heartbeat").start()
+    return stop
+
+
+class HeartbeatMonitor:
+    """Launcher side: declare a rank hung when its counter stops moving
+    for longer than ``timeout`` (measured on the monitor's clock)."""
+
+    def __init__(self, store, job_id: str, nproc: int, timeout: float):
+        self._store = store
+        self._job_id = job_id
+        self._nproc = nproc
+        self._timeout = timeout
+        # rank -> (last counter value, monitor time it last changed)
+        self._seen: Dict[int, tuple] = {}
+
+    def reset(self):
+        self._seen.clear()
+
+    def stale_ranks(self, restart_count: int,
+                    now: Optional[float] = None) -> List[int]:
+        # monotonic: an NTP step on the launcher must not declare every
+        # healthy rank hung
+        now = time.monotonic() if now is None else now
+        stale = []
+        for rank in range(self._nproc):
+            key = _hb_key(self._job_id, str(restart_count), str(rank))
+            raw = self._store.get(key)
+            if raw is None:
+                continue  # never beat: not monitored (opt-in contract)
+            try:
+                val = int(raw)
+            except ValueError:
+                continue
+            prev = self._seen.get(rank)
+            if prev is None or prev[0] != val:
+                self._seen[rank] = (val, now)
+            elif now - prev[1] > self._timeout:
+                stale.append(rank)
+        return stale

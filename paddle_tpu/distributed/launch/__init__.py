@@ -47,7 +47,8 @@ class LocalJob:
     def __init__(self, script: str, script_args: List[str], nproc: int,
                  master: Optional[str] = None, log_dir: str = "log",
                  job_id: str = "default", max_restarts: int = 3,
-                 use_module: bool = False):
+                 use_module: bool = False,
+                 heartbeat_timeout: Optional[float] = None):
         self.script = script
         self.script_args = script_args
         self.nproc = nproc
@@ -55,8 +56,10 @@ class LocalJob:
         self.job_id = job_id
         self.max_restarts = max_restarts
         self.use_module = use_module
+        self.heartbeat_timeout = heartbeat_timeout
         self.restart_count = 0
         self._store = None
+        self._monitor = None
         if master:
             host, port = master.rsplit(":", 1)
             self.master_host, self.master_port = host, int(port)
@@ -68,6 +71,11 @@ class LocalJob:
         self._store = TCPStore(self.master_host, self.master_port,
                                is_master=True, timeout=300)
         self.master_port = self._store.port
+        if self.heartbeat_timeout:
+            from ..fleet.elastic import HeartbeatMonitor
+            self._monitor = HeartbeatMonitor(
+                self._store, self.job_id, self.nproc,
+                self.heartbeat_timeout)
 
     def _spawn_one(self, rank: int) -> _Worker:
         env = dict(os.environ)
@@ -131,6 +139,8 @@ class LocalJob:
     def _watch(self, workers, poll_interval) -> int:
         """Block until all workers exit 0 (return 0) or any fails
         (kill the gang, return its rc)."""
+        if self._monitor is not None:
+            self._monitor.reset()
         try:
             while True:
                 alive = False
@@ -146,6 +156,17 @@ class LocalJob:
                         return rc
                 if not alive:
                     return 0
+                if self._monitor is not None:
+                    stale = self._monitor.stale_ranks(self.restart_count)
+                    stale = [r for r in stale
+                             if workers[r].proc.poll() is None]
+                    if stale:
+                        sys.stderr.write(
+                            f"launch: ranks {stale} heartbeat-stale "
+                            f"(> {self.heartbeat_timeout}s): "
+                            "declaring hung\n")
+                        self._kill_all(workers)
+                        return 1
                 time.sleep(poll_interval)
         except KeyboardInterrupt:
             self._kill_all(workers)
@@ -170,6 +191,10 @@ def main(argv=None) -> int:
     parser.add_argument("--job_id", default="default")
     parser.add_argument("--log_dir", default="log")
     parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--heartbeat_timeout", type=float, default=None,
+                        help="declare a rank hung when its heartbeat "
+                             "(fleet.elastic.start_heartbeat) stalls "
+                             "this many seconds; hung pods gang-restart")
     parser.add_argument("--module", action="store_true",
                         help="run script as a python module (-m)")
     parser.add_argument("script")
@@ -179,7 +204,8 @@ def main(argv=None) -> int:
     job = LocalJob(args.script, args.script_args, args.nproc_per_node,
                    master=args.master, log_dir=args.log_dir,
                    job_id=args.job_id, max_restarts=args.max_restarts,
-                   use_module=args.module)
+                   use_module=args.module,
+                   heartbeat_timeout=args.heartbeat_timeout)
     try:
         return job.run()
     finally:

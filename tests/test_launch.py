@@ -81,3 +81,35 @@ def test_ps_scope_out_raises():
     assert not ps.is_supported()
     with pytest.raises(NotImplementedError, match="out of scope"):
         ps.ParameterServerOptimizer()
+
+
+_SCRIPT_HANG_ONE = """
+import os, time
+from paddle_tpu.distributed.fleet import elastic
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+stop = elastic.start_heartbeat(interval=0.2)
+print(f"start rank={rank} restart={restart}", flush=True)
+if rank == 1 and restart == 0:
+    stop.set()        # heartbeat stalls: simulated in-process hang
+    time.sleep(120)   # never finishes; the launcher must detect it
+print(f"done rank={rank} restart={restart}", flush=True)
+"""
+
+
+def test_launch_detects_hung_worker_via_heartbeat(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(_SCRIPT_HANG_ONE))
+    log_dir = tmp_path / "log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         "--max_restarts", "2", "--heartbeat_timeout", "3", str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stderr[-2000:],)
+    assert "heartbeat-stale" in proc.stderr
+    assert "gang restart 1/2" in proc.stderr
+    log1 = (log_dir / "workerlog.1").read_text()
+    assert "done rank=1 restart=1" in log1
