@@ -38,6 +38,12 @@ def start_heartbeat(interval: float = 2.0, store=None) -> threading.Event:
         from ..store import TCPStore
         host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
         store = TCPStore(host, int(port), is_master=False, timeout=60)
+        if not store.is_native:
+            import warnings
+            warnings.warn(
+                "TCPStore fell back to the in-process store: heartbeats "
+                "cannot reach the launcher, so --heartbeat_timeout will "
+                "not detect hangs on this host")
     key = _hb_key(os.environ.get("PADDLE_JOB_ID", "default"),
                   os.environ.get("PADDLE_RESTART_COUNT", "0"),
                   os.environ.get("PADDLE_TRAINER_ID", "0"))

@@ -8,7 +8,10 @@ from .ops.registry import _ensure_tensor
 
 __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
            "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
-           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+           "hfft2", "ihfft2", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+           "fft_c2c", "fft_r2c", "fft_c2r",
+           "fftn_c2c", "fftn_r2c", "fftn_c2r"]
 
 
 def _fft1(name, jfn):
@@ -65,6 +68,81 @@ def fftfreq(n, d=1.0, dtype=None, name=None):
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     from .core.tensor import Tensor
     return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def _swap_norm(norm):
+    # the Hermitian transforms are conjugate-flipped real transforms with
+    # forward/backward normalization exchanged (numpy hfft identity:
+    # hfft(a, n) == irfft(conj(a), n) * n  for norm="backward")
+    return {None: "forward", "backward": "forward",
+            "forward": "backward", "ortho": "ortho"}[norm]
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D FFT of Hermitian-symmetric input → real output
+    (reference: python/paddle/fft.py:782 hfftn → fftn_c2r kernel)."""
+    x = _ensure_tensor(x)
+    return apply_op(
+        lambda a: jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes,
+                                 norm=_swap_norm(norm)),
+        x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D inverse FFT of a real-spectrum signal → Hermitian output
+    (reference: python/paddle/fft.py:831 ihfftn → fftn_r2c kernel)."""
+    x = _ensure_tensor(x)
+    return apply_op(
+        lambda a: jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes,
+                                         norm=_swap_norm(norm))),
+        x, op_name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+# low-level entry points (reference fft.py:1432-1660 — the kernel-shaped
+# API paddle exposes publicly; forward=False runs the inverse transform)
+
+def fft_c2c(x, n, axis, norm, forward, name=None):
+    return (fft if forward else ifft)(x, n=n, axis=axis, norm=norm)
+
+
+def fft_r2c(x, n, axis, norm, forward, onesided, name=None):
+    if not onesided:
+        return (fft if forward else ifft)(x, n=n, axis=axis, norm=norm)
+    if forward:
+        return rfft(x, n=n, axis=axis, norm=norm)
+    return ihfft(x, n=n, axis=axis, norm=norm)
+
+
+def fft_c2r(x, n, axis, norm, forward, name=None):
+    if forward:
+        return hfft(x, n=n, axis=axis, norm=norm)
+    return irfft(x, n=n, axis=axis, norm=norm)
+
+
+def fftn_c2c(x, s, axes, norm, forward, name=None):
+    return (fftn if forward else ifftn)(x, s=s, axes=axes, norm=norm)
+
+
+def fftn_r2c(x, s, axes, norm, forward, onesided, name=None):
+    if not onesided:
+        return (fftn if forward else ifftn)(x, s=s, axes=axes, norm=norm)
+    if forward:
+        return rfftn(x, s=s, axes=axes, norm=norm)
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftn_c2r(x, s, axes, norm, forward, name=None):
+    if forward:
+        return hfftn(x, s=s, axes=axes, norm=norm)
+    return irfftn(x, s=s, axes=axes, norm=norm)
 
 
 def fftshift(x, axes=None, name=None):

@@ -9,6 +9,18 @@ module — XLA already did the fusion/optimization work at export time — and
 the predictor simply binds inputs, runs the compiled executable, and
 returns host arrays. Mixed precision / device placement are jit-time
 properties of the exported function.
+
+C++ serving host (scope note): the StableHLO artifact is the stable,
+language-neutral boundary — a C++ loader would drive it through the PJRT
+C API (PJRT_Client_Compile + PJRT_LoadedExecutable_Execute against
+libtpu's GetPjrtApi). That loader is NOT buildable in this tree today:
+the installed jaxlib links its PJRT clients statically into the python
+extension and ships neither the pjrt_c_api.h header nor a standalone
+plugin .so to link against. When a libtpu/PJRT SDK is present, the
+loader is a thin consumer of the exact .stablehlo files jit.save already
+produces — no framework changes needed. ONNX export is likewise gated:
+no onnx runtime in this environment; the StableHLO artifact is the
+supported interchange format.
 """
 from __future__ import annotations
 

@@ -60,16 +60,73 @@ class dlpack:
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    """Rough FLOPs estimate by layer type (reference: utils/flops.py)."""
-    import numpy as np
-    from ..nn import Linear, Conv2D
-    total = [0]
+    """FLOPs by a hooked dummy forward with real shapes
+    (reference: python/paddle/utils/flops.py + hapi/dynamic_flops.py —
+    per-layer handlers over forward hooks).
 
-    def count(layer):
+    custom_ops: {LayerType: fn(layer, inputs, output) -> flops} overrides.
+    """
+    import numpy as np
+    from .. import to_tensor
+
+    def _numel(t):
+        return int(np.prod(t.shape)) if hasattr(t, "shape") else 0
+
+    def _count(layer, inputs, output):
+        from ..nn import (Linear, Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+                          Embedding)
+        from ..nn.layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,
+                                     BatchNorm3D, LayerNorm, GroupNorm,
+                                     InstanceNorm2D)
+        x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+        if custom_ops and type(layer) in custom_ops:
+            return int(custom_ops[type(layer)](layer, inputs, output))
         if isinstance(layer, Linear):
-            total[0] += 2 * layer._in_features * layer._out_features
-        elif isinstance(layer, Conv2D):
-            k = np.prod(layer._kernel_size)
-            total[0] += 2 * layer._in_channels * layer._out_channels * k
-    net.apply(count)
+            rows = _numel(x) // max(layer._in_features, 1)
+            return 2 * rows * layer._in_features * layer._out_features
+        if isinstance(layer, (Conv1D, Conv2D, Conv3D, Conv2DTranspose)):
+            k = int(np.prod(layer._kernel_size))
+            cin = layer._in_channels // max(layer._groups, 1)
+            return 2 * cin * k * _numel(output)
+        if isinstance(layer, (BatchNorm, BatchNorm1D, BatchNorm2D,
+                              BatchNorm3D, LayerNorm, GroupNorm,
+                              InstanceNorm2D)):
+            return 2 * _numel(x)
+        if isinstance(layer, Embedding):
+            return 0
+        cls = type(layer).__name__
+        if cls in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
+                   "LeakyReLU", "SiLU", "Swish", "Hardswish", "PReLU"):
+            return _numel(output)
+        if cls in ("AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D",
+                   "AdaptiveMaxPool2D", "AvgPool1D", "MaxPool1D"):
+            return _numel(output)
+        return 0
+
+    rows = []
+    total = [0]
+    handles = []
+
+    def make_hook(layer):
+        def hook(lay, inputs, output):
+            f = _count(lay, inputs, output)
+            if f:
+                rows.append((type(lay).__name__, f))
+                total[0] += f
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        if not list(sub.children()):  # leaf layers only
+            handles.append(sub.register_forward_post_hook(make_hook(sub)))
+    try:
+        shape = list(input_size)
+        x = to_tensor(np.zeros(shape, np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+    if print_detail:
+        for name, f in rows:
+            print(f"{name:<24}{f:>16,}")
+        print(f"{'Total':<24}{total[0]:>16,}")
     return total[0]

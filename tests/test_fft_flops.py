@@ -1,0 +1,85 @@
+"""Hermitian N-D FFTs + low-level transform entry points, and the
+hooked-forward FLOPs counter.
+
+Reference analog: python/paddle/fft.py:782-878 (hfftn/ihfftn over
+fftn_c2r/fftn_r2c), :1432-1660 (public low-level c2c/r2c/c2r), and
+python/paddle/hapi/dynamic_flops.py (per-layer FLOPs over hooks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft
+
+
+def test_hfftn_reference_example():
+    # the reference docstring's own example (fft.py:818)
+    x = paddle.to_tensor(np.array([2 + 2j, 2 + 2j, 3 + 3j], np.complex64))
+    np.testing.assert_allclose(fft.hfftn(x).numpy(), [9.0, 3.0, 1.0, -5.0],
+                               atol=1e-5)
+    import jax.numpy as jnp
+    np.testing.assert_allclose(fft.hfftn(x).numpy(),
+                               np.asarray(jnp.fft.hfft(x.numpy())),
+                               rtol=1e-5)
+
+
+def test_hfft2_ihfft2_roundtrip():
+    y = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (4, 6)).astype(np.float32))
+    for norm in ("backward", "forward", "ortho"):
+        sp = fft.ihfft2(y, norm=norm)
+        rec = fft.hfft2(sp, s=[4, 6], norm=norm)
+        np.testing.assert_allclose(rec.numpy(), y.numpy(), atol=1e-4,
+                                   err_msg=norm)
+
+
+def test_low_level_transforms_match_public():
+    x = np.random.default_rng(1).standard_normal((8,)).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(
+        fft.fft_r2c(t, None, -1, "backward", True, True).numpy(),
+        fft.rfft(t).numpy(), rtol=1e-5)
+    c = fft.fft(t)
+    np.testing.assert_allclose(
+        fft.fft_c2c(c, None, -1, "backward", False).numpy(),
+        fft.ifft(c).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        fft.fft_c2r(fft.rfft(t), 8, -1, "backward", False).numpy(),
+        x, atol=1e-5)
+
+
+def test_flops_lenet_exact():
+    net = paddle.vision.models.LeNet()
+    f = paddle.utils.flops(net, [1, 1, 28, 28])
+    # conv1 (1->6, 3x3, pad 1): 2*9*6*28*28 = 84,672; conv2 (6->16, 5x5):
+    # 2*6*25*16*10*10 = 480,000; fc: 96,000 + 20,160 + 1,680;
+    # relu/pool: 4,704 + 1,600 + 1,176 + 400
+    assert f == 690_392, f
+
+
+def test_flops_custom_ops_override():
+    from paddle_tpu.nn import Linear
+    net = paddle.nn.Sequential(Linear(4, 8))
+    f = paddle.utils.flops(net, [2, 4],
+                           custom_ops={Linear: lambda l, i, o: 12345})
+    assert f == 12345
+
+
+def test_fft_r2c_inverse_matches_ihfft():
+    # the r02-class of bug: forward=False one-sided r2c must be ihfft
+    # (normalization swapped), not an unscaled conj(rfft)
+    x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+        (8,)).astype(np.float32))
+    np.testing.assert_allclose(
+        fft.fft_r2c(x, None, -1, "backward", False, True).numpy(),
+        fft.ihfft(x).numpy(), rtol=1e-5)
+    x2 = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+        (4, 6)).astype(np.float32))
+    np.testing.assert_allclose(
+        fft.fftn_r2c(x2, None, None, "backward", False, True).numpy(),
+        fft.ihfftn(x2).numpy(), rtol=1e-5)
+
+
+def test_hermitian_transforms_accept_none_norm():
+    x = paddle.to_tensor(np.array([1 + 1j, 2 - 1j], np.complex64))
+    out = fft.hfftn(x, norm=None)
+    np.testing.assert_allclose(out.numpy(), fft.hfftn(x, norm="backward").numpy())
