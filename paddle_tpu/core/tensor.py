@@ -511,6 +511,12 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
         t = Tensor(arr, stop_gradient=stop_gradient)
         return t
     dt = dtype_mod.convert_dtype(dtype)
+    if isinstance(data, jax.Array):
+        # jax arrays (incl. tracers under jit) pass through — np.asarray
+        # would fail on a tracer and force a host round-trip on a
+        # concrete device array
+        jarr = data if dt is None else data.astype(dt)
+        return Tensor(jarr, stop_gradient=stop_gradient)
     if isinstance(data, (bool, int, float, complex)) and dt is None:
         if isinstance(data, bool):
             dt = jnp.bool_

@@ -1,0 +1,176 @@
+"""Op codegen — the single-source-of-truth machinery over the registry.
+
+Reference analog: paddle/phi/api/yaml/ (ops.yaml + legacy_ops.yaml) and
+its generators (api_gen.py, eager_gen.py:192 emitting <op>_ad_func,
+python_c_gen.py:87 emitting the CPython eager_api_<op> wrappers that
+become paddle._C_ops.<op>). There, one YAML record generates the C++
+API, dispatch, autograd node, and python binding.
+
+Here the single source is ops.registry.OP_LIBRARY (name -> python API +
+jnp lowering). From it this module derives, instead of generating C++:
+
+- export_manifest(): an ops.yaml-shaped text manifest of every
+  registered op (name, python signature, lowering implementation site) —
+  the introspection artifact the YAML files provide in the reference.
+- _C_ops (paddle_tpu/_C_ops.py consumes this): the eager fast path. In
+  the reference, `_C_ops.<op>` is a generated CPython wrapper that skips
+  the python API layer; here it is the registered array-level lowering
+  wrapped in jax.jit, skipping the Tensor facade entirely.
+- parity_cases(): (name, lowering, numpy_fn) triples for every
+  registered op with an identically-named numpy ufunc — the
+  auto-generated OpTest sweep (tests/test_ops_generated.py runs them),
+  standing in for the YAML-generated kernel unit tests.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from .registry import OP_LIBRARY
+
+__all__ = ["export_manifest", "fast_op", "parity_cases"]
+
+
+def _signature(fn: Callable) -> str:
+    try:
+        return str(inspect.signature(fn))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _impl_site(fn: Callable) -> str:
+    mod = getattr(fn, "__module__", "?")
+    qual = getattr(fn, "__qualname__", getattr(fn, "__name__", "?"))
+    return f"{mod}.{qual}"
+
+
+def export_manifest(path: Optional[str] = None) -> str:
+    """ops.yaml-shaped manifest of the full registered op surface."""
+    lines = ["# generated from ops.registry.OP_LIBRARY — do not edit",
+             f"# ops: {len(OP_LIBRARY)}", ""]
+    for name in sorted(OP_LIBRARY):
+        info = OP_LIBRARY[name]
+        lines += [f"- op : {name}",
+                  f"  args : {_signature(info.fn)}",
+                  f"  api : {_impl_site(info.fn)}",
+                  f"  lowering : {_impl_site(info.lowering)}",
+                  ""]
+    text = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+_FAST_CACHE: Dict[str, Callable] = {}
+
+
+def fast_op(name: str) -> Callable:
+    """The _C_ops fast path: the registered array-level lowering under
+    jax.jit (compiled once per shape/dtype), bypassing the Tensor
+    facade — the analog of the generated eager_api_<op> wrappers."""
+    fn = _FAST_CACHE.get(name)
+    if fn is None:
+        info = OP_LIBRARY.get(name)
+        if info is None:
+            raise AttributeError(f"_C_ops has no op '{name}'")
+        fn = _make_fast(info.lowering)
+        _FAST_CACHE[name] = fn
+    return fn
+
+
+def _make_fast(lowering: Callable) -> Callable:
+    import numpy as np
+
+    def unwrap(out):
+        # ops registered without an explicit array-level lowering fall
+        # back to the Tensor-level API; unwrap outputs so the surface is
+        # arrays-in/arrays-out either way
+        from ..core.tensor import Tensor
+        return jax.tree_util.tree_map(
+            lambda t: t._array if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    jit_cache: Dict = {}
+
+    def call(*args, **kw):
+        # paddle's _C_ops convention passes attrs (axis ints, dtype
+        # strings, shape lists) positionally next to the tensors; only
+        # array operands may be traced — everything else is static and
+        # keys a separate jit specialization
+        dyn_idx = tuple(i for i, a in enumerate(args)
+                        if isinstance(a, (jax.Array, np.ndarray)))
+        statics = tuple((i, _freeze(a)) for i, a in enumerate(args)
+                        if i not in dyn_idx)
+        key = (dyn_idx, statics, tuple(sorted(
+            (k, _freeze(v)) for k, v in kw.items())))
+        try:
+            jitted = jit_cache.get(key)
+        except TypeError:  # unhashable attr: run uncompiled
+            return unwrap(lowering(*args, **kw))
+        if jitted is None:
+            static_args = {i: a for i, a in enumerate(args)
+                           if i not in dyn_idx}
+
+            def array_fn(*dyn):
+                full = list(args)
+                for slot, d in zip(dyn_idx, dyn):
+                    full[slot] = d
+                for slot, s in static_args.items():
+                    full[slot] = s
+                return unwrap(lowering(*full, **kw))
+
+            jitted = jax.jit(array_fn)
+            jit_cache[key] = jitted
+        return jitted(*(args[i] for i in dyn_idx))
+
+    return call
+
+
+def _freeze(v):
+    if isinstance(v, list):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+# numpy names whose paddle semantics differ enough that a blind
+# same-name comparison would be wrong — excluded from the generated sweep
+_PARITY_SKIP = {
+    "round",      # paddle rounds half away from zero; numpy half-to-even
+    "empty_like",  # contents undefined — value comparison is meaningless
+    "nonzero",    # paddle returns a stacked index tensor, numpy a tuple
+    "clip", "all", "any", "amax", "amin", "angle", "cumsum", "cumprod",
+    "diff", "dot", "cross", "kron", "outer", "trace", "tril", "triu",
+    "repeat", "sort", "argsort", "split", "stack", "squeeze", "take",
+    "where", "histogram", "median", "quantile", "nanmedian",
+    "nanquantile", "prod", "std", "var", "mean", "sum", "broadcast_to",
+    "flip", "roll", "rot90", "moveaxis", "transpose", "reshape",
+}
+
+
+def parity_cases() -> List[Tuple[str, Callable, Callable, int]]:
+    """(name, lowering, numpy_fn, n_positional_params) for ops sharing a
+    numpy ufunc name — the generated elementwise test sweep."""
+    import numpy as np
+    cases = []
+    for name in sorted(OP_LIBRARY):
+        if name in _PARITY_SKIP:
+            continue
+        np_fn = getattr(np, name, None)
+        if np_fn is None or not callable(np_fn):
+            continue
+        lowering = OP_LIBRARY[name].lowering
+        try:
+            n_params = len([
+                p for p in inspect.signature(lowering).parameters.values()
+                if p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)])
+        except (TypeError, ValueError):
+            continue
+        if n_params in (1, 2):
+            cases.append((name, lowering, np_fn, n_params))
+    return cases

@@ -1,0 +1,75 @@
+"""Generated op surface tests from the registry (the YAML-codegen
+analog's test half).
+
+Reference analog: the per-op unit tests generated alongside the YAML op
+definitions (paddle/phi/api/yaml + test_ops.py patterns in
+fluid/tests/unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import codegen
+from paddle_tpu.ops.registry import OP_LIBRARY
+
+_CASES = codegen.parity_cases()
+
+
+def test_sweep_is_substantial():
+    # the generated sweep must actually cover a meaningful op slice
+    assert len(_CASES) >= 40, [c[0] for c in _CASES]
+
+
+@pytest.mark.parametrize("case", _CASES, ids=[c[0] for c in _CASES])
+def test_lowering_matches_numpy(case):
+    name, lowering, np_fn, n_params = case
+    rng = np.random.default_rng(0)
+    # domain-safe inputs: positive, <1 in magnitude where inverse-trig
+    # or log domains apply
+    x = (rng.uniform(0.1, 0.9, (3, 4))).astype(np.float32)
+    try:
+        if n_params == 1:
+            got = np.asarray(lowering(x))
+            want = np_fn(x)
+        else:
+            y = (rng.uniform(0.1, 0.9, (3, 4))).astype(np.float32)
+            got = np.asarray(lowering(x, y))
+            want = np_fn(x, y)
+    except (TypeError, ValueError) as e:
+        pytest.skip(f"{name}: signature mismatch with numpy ({e})")
+    if np.asarray(want).dtype.kind not in "fc":
+        want = np.asarray(want).astype(got.dtype)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-5, atol=2e-6,
+                               err_msg=name)
+
+
+def test_manifest_covers_registry(tmp_path):
+    text = codegen.export_manifest(str(tmp_path / "ops_manifest.yaml"))
+    for probe in ("- op : matmul", "- op : softmax", "- op : conv2d"):
+        assert probe in text
+    assert text.count("- op : ") == len(OP_LIBRARY)
+
+
+def test_c_ops_fast_path():
+    from paddle_tpu import _C_ops
+    x = np.arange(6.0, dtype=np.float32).reshape(2, 3)
+    out = _C_ops.add(x, x)
+    np.testing.assert_allclose(np.asarray(out), 2 * x)
+    # resolved attribute is cached and jitted
+    assert _C_ops.add is _C_ops.add
+    with pytest.raises(AttributeError):
+        _C_ops.definitely_not_an_op
+    assert "matmul" in dir(_C_ops)
+
+
+def test_c_ops_handles_static_attrs():
+    from paddle_tpu import _C_ops
+    x = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+    # int axis attr
+    np.testing.assert_allclose(np.asarray(_C_ops.cumsum(x, 1)),
+                               np.cumsum(x, 1), rtol=1e-6)
+    # negative-axis softmax
+    s = np.asarray(_C_ops.softmax(x, -1))
+    np.testing.assert_allclose(s.sum(-1), np.ones(3), rtol=1e-6)
+    # same op, different static attr → different specialization, both fine
+    np.testing.assert_allclose(np.asarray(_C_ops.cumsum(x, 0)),
+                               np.cumsum(x, 0), rtol=1e-6)
