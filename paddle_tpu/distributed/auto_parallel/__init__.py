@@ -19,6 +19,8 @@ from __future__ import annotations
 from .placements import Shard, Replicate, Partial, to_partition_spec
 from .strategy import Strategy
 from .engine import Engine
+from .planner import ShardingPlanner
+from . import cost_model
 from ..mesh import ProcessMesh, get_mesh
 from ..shard import (shard_tensor, shard_op, shard_layer,
                      with_sharding_constraint, shard_params,

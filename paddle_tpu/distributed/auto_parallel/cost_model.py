@@ -1,0 +1,73 @@
+"""Collective + memory cost model for sharding decisions.
+
+Reference analog: python/paddle/distributed/auto_parallel/cost/
+(comm_op_cost.py AllreduceSumOpCost/AllgatherOpCost with alpha-beta
+ring-time formulas, cost_model.py) feeding planner_v2/tuner.
+
+TPU-native: the alpha-beta constants model ICI, not NVLink/IB. The ring
+formulas are topology-independent in shape — what changes is the link
+bandwidth and that TPU meshes give each axis its own dedicated ICI
+links (so per-axis costs add, they don't contend). Bandwidth default is
+v5p-class ICI (~100 GB/s effective per link direction); override for
+other generations. All costs are in microseconds so they compose with
+the reference's convention.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["CommContext", "all_reduce_cost", "all_gather_cost",
+           "reduce_scatter_cost", "all_to_all_cost", "p2p_cost"]
+
+
+class CommContext:
+    """Per-axis link model: bandwidth (bytes/us) + latency (us/hop)."""
+
+    def __init__(self, ici_bandwidth_gbps: float = 100.0,
+                 latency_us: float = 1.0,
+                 dcn_bandwidth_gbps: float = 12.5,
+                 dcn_axes: Sequence[str] = ()):
+        self.bw = ici_bandwidth_gbps * 1e9 / 1e6  # bytes per microsecond
+        self.dcn_bw = dcn_bandwidth_gbps * 1e9 / 1e6
+        self.lat = latency_us
+        self.dcn_axes = set(dcn_axes)
+
+    def axis_bw(self, axis_name: Optional[str]) -> float:
+        if axis_name in self.dcn_axes:
+            return self.dcn_bw
+        return self.bw
+
+
+def _ring(nbytes: int, n: int, ctx: CommContext, axis=None,
+          factor: float = 1.0) -> float:
+    """alpha-beta ring time: (n-1) latency hops + (n-1)/n of the payload
+    over the link, scaled by `factor` (1 for gather/scatter, 2 for
+    all-reduce = reduce-scatter + all-gather)."""
+    if n <= 1:
+        return 0.0
+    bw = ctx.axis_bw(axis)
+    return factor * ((n - 1) * ctx.lat + (n - 1) / n * nbytes / bw)
+
+
+def all_reduce_cost(nbytes, n, ctx=None, axis=None):
+    return _ring(nbytes, n, ctx or CommContext(), axis, factor=2.0)
+
+
+def all_gather_cost(nbytes, n, ctx=None, axis=None):
+    return _ring(nbytes, n, ctx or CommContext(), axis, factor=1.0)
+
+
+def reduce_scatter_cost(nbytes, n, ctx=None, axis=None):
+    return _ring(nbytes, n, ctx or CommContext(), axis, factor=1.0)
+
+
+def all_to_all_cost(nbytes, n, ctx=None, axis=None):
+    if n <= 1:
+        return 0.0
+    ctx = ctx or CommContext()
+    return (n - 1) * ctx.lat + (n - 1) / n * nbytes / ctx.axis_bw(axis)
+
+
+def p2p_cost(nbytes, ctx=None, axis=None):
+    ctx = ctx or CommContext()
+    return ctx.lat + nbytes / ctx.axis_bw(axis)

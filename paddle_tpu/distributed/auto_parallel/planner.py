@@ -1,0 +1,137 @@
+"""Sharding planner: cost-model-driven PartitionSpec selection.
+
+Reference analog: python/paddle/distributed/auto_parallel/planner_v2.py
+(Planner: completion + rule-based dist-attr search over the cost model)
+and tuner/ (profile-guided search). The reference searches per-op
+dist_attrs for a program graph; on the TPU stack the searchable object
+is simpler — a PartitionSpec per parameter — because XLA/GSPMD derives
+every activation sharding and inserts collectives once the parameter
+and batch placements are fixed.
+
+Per leaf the planner scores each candidate spec (replicated, or one
+mesh axis on one divisible dim, or stacked combinations on distinct
+dims) with:
+
+    cost = per_device_bytes                       (memory pressure)
+         + all_gather_cost(gathered_bytes)        (weights move per step
+           when sharded on a data axis — the ZeRO-3 tradeoff)
+         + all_reduce_cost(grad_bytes over data axes the weight is NOT
+           sharded on)                            (grad sync)
+
+weighted by ``mem_weight`` (HBM scarcity knob). The plan is
+deterministic, explainable (``explain=True`` returns the scored
+candidates), and feeds directly into NamedSharding/shard_tensor.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .cost_model import (CommContext, all_gather_cost, all_reduce_cost,
+                         reduce_scatter_cost)
+
+__all__ = ["ShardingPlanner"]
+
+
+class ShardingPlanner:
+    def __init__(self, mesh, data_axes: Sequence[str] = ("dp",),
+                 ctx: Optional[CommContext] = None,
+                 mem_weight: float = 1.0, dtype_bytes: int = 4,
+                 max_axes_per_tensor: int = 2):
+        self.mesh = mesh
+        self.axis_sizes = dict(zip(mesh.axis_names,
+                                   np.asarray(mesh.devices).shape))
+        self.data_axes = [a for a in data_axes if a in self.axis_sizes]
+        self.ctx = ctx or CommContext()
+        self.mem_weight = mem_weight
+        self.dtype_bytes = dtype_bytes
+        self.max_axes = max_axes_per_tensor
+
+    # -- candidate generation ------------------------------------------
+    def _candidates(self, shape) -> List[Tuple]:
+        axes = [(a, n) for a, n in self.axis_sizes.items() if n > 1]
+        cands = [tuple([None] * len(shape))]
+        for r in range(1, self.max_axes + 1):
+            # combinations x permutations covers every axis->dim
+            # assignment exactly once (permutations x permutations would
+            # generate each r! times)
+            for axis_combo in itertools.combinations(axes, r):
+                for dim_combo in itertools.permutations(
+                        range(len(shape)), r):
+                    ok = all(shape[d] % n == 0 and shape[d] >= n
+                             for (_, n), d in zip(axis_combo, dim_combo))
+                    if not ok:
+                        continue
+                    spec = [None] * len(shape)
+                    for (a, _), d in zip(axis_combo, dim_combo):
+                        spec[d] = a
+                    cands.append(tuple(spec))
+        return list(dict.fromkeys(cands))
+
+    # -- scoring -------------------------------------------------------
+    def _score(self, shape, spec) -> float:
+        total = int(np.prod(shape)) * self.dtype_bytes if shape else \
+            self.dtype_bytes
+        shard_factor = 1
+        used_axes = [a for a in spec if a is not None]
+        for a in used_axes:
+            shard_factor *= self.axis_sizes[a]
+        per_dev = total / shard_factor
+        cost = self.mem_weight * per_dev / self.ctx.bw  # bytes→us scale
+        # every sharded axis implies at least one ICI hop of latency at a
+        # use site (a gather, a partial-sum, a halo); this keeps the
+        # planner from sharding tiny tensors for an epsilon of memory
+        cost += self.ctx.lat * len(used_axes)
+
+        # sharding a weight over a DATA axis = ZeRO-3: all-gathered twice
+        # per step (forward + backward recompute of the gather) and its
+        # gradient reduce-scattered — 3 payload units vs all-reduce's 2,
+        # which is exactly why ZeRO-3 only wins under memory pressure.
+        # The payload each dp-group member moves is the tensor AFTER any
+        # model-axis sharding (a dp+mp hybrid gathers 1/mp of the rows).
+        nondata = 1
+        for a in used_axes:
+            if a not in self.data_axes:
+                nondata *= self.axis_sizes[a]
+        payload = total / nondata
+        for a in used_axes:
+            if a in self.data_axes:
+                n = self.axis_sizes[a]
+                cost += 2 * all_gather_cost(payload, n, self.ctx, a)
+                cost += reduce_scatter_cost(payload, n, self.ctx, a)
+        # grad sync: all-reduce over every data axis the weight is not
+        # itself sharded on
+        for a in self.data_axes:
+            if a not in used_axes:
+                cost += all_reduce_cost(per_dev, self.axis_sizes[a],
+                                        self.ctx, a)
+        return cost
+
+    # -- public --------------------------------------------------------
+    def plan_leaf(self, shape, explain: bool = False):
+        cands = self._candidates(tuple(shape))
+        scored = sorted(((self._score(shape, c), c) for c in cands),
+                        key=lambda t: t[0])
+        best = P(*scored[0][1]) if shape else P()
+        if explain:
+            return best, [(c, s) for s, c in scored]
+        return best
+
+    def plan(self, tree) -> Any:
+        """Pytree of shapes (tuples/lists or arrays with .shape) →
+        pytree of PartitionSpecs."""
+        import jax
+
+        def leaf_shape(x):
+            if hasattr(x, "shape"):
+                return tuple(x.shape)
+            return tuple(x)
+
+        return jax.tree_util.tree_map(
+            lambda x: self.plan_leaf(leaf_shape(x)), tree,
+            is_leaf=lambda x: hasattr(x, "shape") or (
+                isinstance(x, (tuple, list))
+                and all(isinstance(i, int) for i in x)))

@@ -1,0 +1,91 @@
+"""Sharding planner + cost model.
+
+Reference analog: auto_parallel planner_v2/tuner tests
+(test_auto_parallel_cost_model.py pattern: cost estimates drive a
+deterministic placement decision)."""
+import numpy as np
+import jax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import ShardingPlanner, cost_model
+
+
+def _mesh(shape, names):
+    devs = np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_cost_model_ring_formulas():
+    ctx = cost_model.CommContext(ici_bandwidth_gbps=100, latency_us=1.0)
+    nbytes = 100e6
+    ar = cost_model.all_reduce_cost(nbytes, 8, ctx)
+    ag = cost_model.all_gather_cost(nbytes, 8, ctx)
+    rs = cost_model.reduce_scatter_cost(nbytes, 8, ctx)
+    assert ar == pytest.approx(ag + rs)        # AR = RS + AG
+    assert cost_model.all_reduce_cost(nbytes, 1, ctx) == 0.0
+    # bigger groups move a larger payload fraction: (n-1)/n grows
+    assert cost_model.all_gather_cost(nbytes, 8, ctx) > \
+        cost_model.all_gather_cost(nbytes, 2, ctx)
+    # DCN axes are slower than ICI axes
+    ctx2 = cost_model.CommContext(dcn_axes=("dcn",))
+    assert cost_model.all_reduce_cost(nbytes, 4, ctx2, axis="dcn") > \
+        cost_model.all_reduce_cost(nbytes, 4, ctx2, axis="mp")
+
+
+def test_planner_shards_big_weights_replicates_small():
+    mesh = _mesh((4, 2), ("dp", "mp"))
+    planner = ShardingPlanner(mesh, data_axes=("dp",))
+    # a big embedding gets sharded over mp (model axis: no per-step
+    # all-gather penalty), not replicated
+    spec = planner.plan_leaf((32000, 4096))
+    assert "mp" in tuple(spec)
+    # a tiny norm vector stays replicated: sharding wins nothing and the
+    # memory term is negligible either way
+    small = planner.plan_leaf((64,))
+    assert tuple(small) in ((None,), ())
+
+
+def test_planner_memory_pressure_flips_to_zero3():
+    mesh = _mesh((8,), ("dp",))
+    shape = (8192, 8192)
+    relaxed = ShardingPlanner(mesh, data_axes=("dp",), mem_weight=0.001)
+    pressured = ShardingPlanner(mesh, data_axes=("dp",), mem_weight=1e4)
+    # relaxed memory: replicate and pay only the grad all-reduce
+    assert tuple(relaxed.plan_leaf(shape)) == (None, None)
+    # scarce memory: shard over dp (ZeRO-3) despite the per-step gather
+    assert "dp" in tuple(pressured.plan_leaf(shape))
+
+
+def test_planner_respects_divisibility_and_tree():
+    mesh = _mesh((4, 2), ("dp", "mp"))
+    planner = ShardingPlanner(mesh, data_axes=("dp",))
+    # 6 is not divisible by 4 or... it is divisible by 2 only
+    spec = planner.plan_leaf((6, 10))
+    for a, d in zip(tuple(spec), (6, 10)):
+        if a is not None:
+            assert d % planner.axis_sizes[a] == 0
+    tree = {"w": (1024, 1024), "b": (64,)}
+    specs = planner.plan(tree)
+    assert set(specs) == {"w", "b"}
+    assert isinstance(specs["w"], P)
+
+
+def test_planner_explain_is_sorted():
+    mesh = _mesh((4, 2), ("dp", "mp"))
+    planner = ShardingPlanner(mesh, data_axes=("dp",))
+    best, ranked = planner.plan_leaf((4096, 4096), explain=True)
+    costs = [c for _, c in ranked]
+    assert costs == sorted(costs)
+    assert tuple(best) == ranked[0][0]
+
+
+def test_planner_hybrid_payload_not_overcharged():
+    # dp+mp hybrid ZeRO-3 gathers only the mp-shard, so under memory
+    # pressure on a dp x mp mesh the hybrid beats dp-only
+    mesh = _mesh((4, 2), ("dp", "mp"))
+    planner = ShardingPlanner(mesh, data_axes=("dp",), mem_weight=1e4)
+    best, ranked = planner.plan_leaf((8192, 8192), explain=True)
+    score = dict((tuple(c), s) for c, s in ranked)
+    assert score[("dp", "mp")] < score[("dp", None)]
+    assert set(tuple(best)) == {"dp", "mp"}
