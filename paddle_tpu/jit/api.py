@@ -53,6 +53,22 @@ class StaticFunction:
         self._fn = fn
         self._input_spec = input_spec
         functools.update_wrapper(self, fn)
+        if not getattr(fn, "_not_to_static", False):
+            # dy2static AST pass: python if/while on tensor predicates
+            # become lax.cond/while_loop via runtime-dispatch helpers
+            # (reference program_translator.py:1225). convert_to_static
+            # returns fn unchanged on its documented fallback cases; an
+            # actual exception is a converter bug — surface it as a
+            # warning and keep the unconverted function
+            try:
+                from .dy2static import convert_to_static
+                fn = convert_to_static(fn)
+            except Exception as e:  # pragma: no cover - converter bug
+                import warnings
+                warnings.warn(
+                    f"dy2static conversion failed for "
+                    f"{getattr(fn, '__qualname__', fn)}: {e!r}; "
+                    "falling back to plain tracing")
 
         def array_fn(*arrays, **kw):
             tensors = _tree_to_tensors(arrays)

@@ -1,0 +1,364 @@
+"""dy2static: AST conversion of python control flow for to_static.
+
+Reference analog: python/paddle/jit/dy2static/program_translator.py:1225
+(StaticFunction → FunctionSpec → convert_to_static: a ~10k-LoC AST
+pipeline whose core transforms are convert_ifelse and
+convert_while_loop in convert_operators.py, rewriting python `if`/
+`while` into conditional_block/while ops with get_args/set_args
+variable plumbing).
+
+TPU-native version: the same source-to-source rewrite, targeting the
+lax-backed ops in static.control_flow. Each `if`/`while` statement
+becomes a call to a runtime helper that dispatches on the predicate at
+trace time — a concrete predicate runs plain python (zero overhead,
+eager semantics preserved), a traced Tensor/array predicate lowers to
+lax.cond / lax.while_loop. Variables assigned inside a branch are
+threaded as explicit inputs/outputs of generated closures (the
+get_args/set_args analog); names that may be unbound before the branch
+are seeded with an UNDEFINED sentinel the helpers refuse to return from
+a taken traced branch.
+
+Conversion contract (documented subset, mirrors the reference's
+supported patterns):
+- `if`/`elif`/`else` and `while` with tensor or python predicates;
+- branch/loop bodies that assign plain names (tuple targets ok);
+- `return`/`break`/`continue` INSIDE a converted block are not
+  rewritten — functions containing them in tensor-predicated blocks
+  keep python semantics and will raise jax's loud tracer error;
+- unsupported shapes of code (no retrievable source, lambdas, already-
+  transformed callables) fall back to plain tracing, like the
+  reference's ast fallback path.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while_loop",
+           "UNDEFINED"]
+
+
+class _Undefined:
+    def __repr__(self):
+        return "<dy2static UNDEFINED>"
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_traced(x) -> bool:
+    from jax.core import Tracer
+    arr = getattr(x, "_array", x)
+    return isinstance(arr, Tracer)
+
+
+_ONE_SIDED_MSG = (
+    "dy2static: a variable assigned in only one branch of a "
+    "tensor-predicated `if` stayed undefined in the other; assign it "
+    "before the `if` or in both branches")
+
+
+def convert_ifelse(pred, true_fn, false_fn, vals):
+    """Runtime dispatch for a rewritten `if` (convert_operators.py
+    convert_ifelse analog). vals: tuple of the variables either branch
+    may assign; both branches return the updated tuple. UNDEFINED leaves
+    coming OUT of a taken concrete branch are handled by the generated
+    `del` cleanup (restoring python's unbound-name semantics); a traced
+    branch returning UNDEFINED raises the clear message during tracing,
+    before jax's opaque leaf-type error could."""
+    if not _is_traced(pred):
+        return true_fn(*vals) if bool(
+            getattr(pred, "_array", pred)) else false_fn(*vals)
+    from ..static.control_flow import cond
+
+    def checked(fn):
+        def g():
+            out = fn(*vals)
+            if any(v is UNDEFINED for v in out):
+                raise ValueError(_ONE_SIDED_MSG)
+            return out
+        return g
+
+    return cond(pred, checked(true_fn), checked(false_fn))
+
+
+def convert_while_loop(cond_fn, body_fn, vals):
+    """Runtime dispatch for a rewritten `while`."""
+    probe = cond_fn(*vals)
+    if not _is_traced(probe):
+        while bool(getattr(probe, "_array", probe)):
+            vals = body_fn(*vals)
+            probe = cond_fn(*vals)
+        return vals
+    if any(v is UNDEFINED for v in vals):
+        raise ValueError(
+            "dy2static: a loop variable of a tensor-predicated `while` "
+            "is unbound before the loop; assign it first (the traced "
+            "loop needs its carry defined on entry)")
+    from ..static.control_flow import while_loop
+    out = while_loop(lambda *a: cond_fn(*a), lambda *a: body_fn(*a),
+                     list(vals))
+    return tuple(out)
+
+
+class _CollectAssigns(ast.NodeVisitor):
+    def __init__(self):
+        self.names = []
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._collect(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._collect(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._collect(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested defs are not threaded through cond/while (function
+        # objects aren't jax values); they stay local to their branch
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _collect(self, target):
+        if isinstance(target, ast.Name):
+            if target.id not in self.names:
+                self.names.append(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._collect(e)
+        # attribute/subscript targets mutate objects, not names: the
+        # closure sees the mutation without threading
+
+
+def _assigned_names(stmts) -> list:
+    c = _CollectAssigns()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+_BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Yield,
+             ast.YieldFrom, ast.Global, ast.Nonlocal)
+
+
+def _has_blocker(stmts) -> bool:
+    """True when the block contains control-transfer statements this pass
+    can't rewrite. Nested function scopes are opaque — a `return` inside
+    an inner def (including the closures a previous rewrite generated)
+    does not transfer control out of THIS block."""
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, _BLOCKERS):
+                return True
+            if walk(child):
+                return True
+        return False
+
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(s, _BLOCKERS):
+            return True
+        if walk(s):
+            return True
+    return False
+
+
+class _Rewriter(ast.NodeTransformer):
+    """Rewrites if/while statements into helper calls with generated
+    closures. Fresh names are prefixed __pt_ to stay out of user space."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"__pt_{kind}_{self.counter}"
+
+    # -- helpers -------------------------------------------------------
+    def _make_fn(self, name, argnames, body_stmts, ret_names):
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in ret_names],
+            ctx=ast.Load()))
+        return ast.FunctionDef(name=name, args=args,
+                               body=list(body_stmts) + [ret],
+                               decorator_list=[], returns=None,
+                               type_params=[])
+
+    def _seed_stmt(self, name):
+        # x = locals().get('x', UNDEFINED) — binds possibly-unbound names
+        # so they can be threaded through the generated closures
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                               args=[], keywords=[]),
+                attr="get", ctx=ast.Load()),
+            args=[ast.Constant(value=name),
+                  ast.Name(id="__pt_UNDEFINED", ctx=ast.Load())],
+            keywords=[])
+        return ast.Assign(
+            targets=[ast.Name(id=name, ctx=ast.Store())], value=call)
+
+    def _unpack_target(self, names):
+        return ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                               for n in names], ctx=ast.Store())
+
+    def _cleanup_stmts(self, names):
+        # `if x is UNDEFINED: del x` — a name no taken branch assigned
+        # goes back to being unbound, so later use raises
+        # UnboundLocalError exactly like the unconverted python would
+        out = []
+        for n in names:
+            test = ast.Compare(
+                left=ast.Name(id=n, ctx=ast.Load()), ops=[ast.Is()],
+                comparators=[ast.Name(id="__pt_UNDEFINED",
+                                      ctx=ast.Load())])
+            out.append(ast.If(
+                test=test,
+                body=[ast.Delete(targets=[
+                    ast.Name(id=n, ctx=ast.Del())])],
+                orelse=[]))
+        return out
+
+    # -- transforms ----------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_blocker(node.body) or _has_blocker(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        if not names:
+            return node
+        tname, fname = self._fresh("true"), self._fresh("false")
+        stmts = [self._seed_stmt(n) for n in names]
+        stmts.append(self._make_fn(tname, names, node.body, names))
+        stmts.append(self._make_fn(fname, names, node.orelse or [ast.Pass()],
+                                   names))
+        call = ast.Call(
+            func=ast.Name(id="__pt_convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        stmts.append(ast.Assign(targets=[self._unpack_target(names)],
+                                value=call))
+        stmts.extend(self._cleanup_stmts(names))
+        return stmts
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_blocker(node.body):
+            return node
+        names = _assigned_names(node.body)
+        if not names:
+            return node
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        stmts = [self._seed_stmt(n) for n in names]
+        cond_fn = self._make_fn(cname, names, [], [])
+        cond_fn.body = [ast.Return(value=node.test)]
+        stmts.append(cond_fn)
+        stmts.append(self._make_fn(bname, names, node.body, names))
+        call = ast.Call(
+            func=ast.Name(id="__pt_convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        stmts.append(ast.Assign(targets=[self._unpack_target(names)],
+                                value=call))
+        stmts.extend(self._cleanup_stmts(names))
+        return stmts
+
+
+def _is_to_static_decorator(node) -> bool:
+    """Syntactically recognize @to_static / @paddle.jit.to_static
+    (optionally called) so exactly those are stripped from the rewrite."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    while isinstance(node, ast.Attribute):
+        if node.attr == "to_static":
+            return True
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "to_static"
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Source-rewrite fn's control flow; returns fn unchanged when the
+    source is unavailable, nothing needs rewriting, or the function's
+    shape is outside the supported subset (closures, foreign decorators)
+    — the reference's fallback behavior.
+
+    Bound methods are converted through their underlying function and
+    re-bound to the same instance.
+    """
+    if inspect.ismethod(fn):
+        import types
+        converted = convert_to_static(fn.__func__)
+        if converted is fn.__func__:
+            return fn
+        return types.MethodType(converted, fn.__self__)
+    if getattr(fn, "__pt_dy2static__", False):
+        return fn
+    if getattr(fn, "__closure__", None):
+        # recompiling would freeze cell contents at conversion time —
+        # later mutations of the closed-over variables would go unseen.
+        # Closure-carrying functions keep plain tracing.
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    # strip only the to_static decorator (re-decorating would recurse);
+    # any OTHER decorator in the source would be silently dropped by a
+    # rewrite, so its presence disables conversion instead
+    kept = [d for d in fdef.decorator_list
+            if not _is_to_static_decorator(d)]
+    if kept:
+        return fn
+    fdef.decorator_list = []
+
+    rewriter = _Rewriter()
+    new_tree = rewriter.visit(tree)
+    if rewriter.counter == 0:
+        return fn  # nothing converted — keep the original object
+    ast.fix_missing_locations(new_tree)
+
+    # execute against the REAL module globals so `global` writes land in
+    # the module and later global rebindings stay visible; only the
+    # handful of __pt_* helpers are added (underscore-prefixed, stable)
+    glb: Dict[str, Any] = fn.__globals__
+    glb.setdefault("__pt_convert_ifelse", convert_ifelse)
+    glb.setdefault("__pt_convert_while", convert_while_loop)
+    glb.setdefault("__pt_UNDEFINED", UNDEFINED)
+    loc: Dict[str, Any] = {}
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    exec(code, glb, loc)
+    out = loc[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__pt_dy2static__ = True
+    return out
