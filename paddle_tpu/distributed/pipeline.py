@@ -28,7 +28,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["pipeline_forward", "pipeline_loss_fn",
-           "pipeline_1f1b_value_and_grad"]
+           "pipeline_1f1b_value_and_grad",
+           "pipeline_interleaved_forward", "pipeline_interleaved_loss_fn"]
 
 
 def pipeline_forward(cfg, mesh, n_micro, params, ids):
@@ -87,18 +88,23 @@ def pipeline_forward(cfg, mesh, n_micro, params, ids):
     return h, aux
 
 
-def pipeline_loss_fn(cfg, mesh, n_micro, params, batch):
-    """Full pipelined loss (used by models.llama.build_train_step)."""
+def _head_loss(cfg, params, h, labels, aux):
+    """Shared norm/lm_head/CE epilogue for every pipelined forward."""
     from ..models.llama import _rms_norm
 
-    ids, labels = batch["input_ids"], batch["labels"]
-    h, aux = pipeline_forward(cfg, mesh, n_micro, params, ids)
     h = _rms_norm(h, params["norm_f"], cfg.rms_norm_eps)
     logits = (h @ params["lm_head"]).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    ce = -jnp.mean(ll)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    ce = jnp.mean(lse - tgt)
     return ce + 0.01 * aux, ce
+
+
+def pipeline_loss_fn(cfg, mesh, n_micro, params, batch):
+    """Full pipelined loss (used by models.llama.build_train_step)."""
+    h, aux = pipeline_forward(cfg, mesh, n_micro, params,
+                              batch["input_ids"])
+    return _head_loss(cfg, params, h, batch["labels"], aux)
 
 
 # ---------------------------------------------------------------------------
@@ -249,3 +255,122 @@ def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
              "lm_head": dhead}
     loss = ce + 0.01 * aux
     return loss, ce, grads
+
+
+# ---------------------------------------------------------------------------
+# interleaved (virtual-stage) schedule
+# ---------------------------------------------------------------------------
+
+def pipeline_interleaved_forward(cfg, mesh, n_micro, v, params, ids):
+    """Circular interleaved pipeline: each device holds ``v`` layer
+    chunks (virtual stages), cutting the bubble fraction from
+    (pp-1)/(m+pp-1) to roughly (pp-1)/(v*m+pp-1).
+
+    Reference analog: pipeline_parallel.py:461
+    (_forward_backward_pipeline with virtual_pp_degree — the interleaved
+    1F1B schedule over chunked PipelineLayer segments).
+
+    TPU-native: global stage g = chunk*pp + device. Microbatches stream
+    in groups of pp (the reference's n_micro % pp == 0 constraint, made
+    exact as group size = pp) through ONE fused scan of
+    T = n_micro*v + pp - 1 ticks: work index r = t - device decomposes
+    into (group, chunk, micro), every device executes exactly one unit
+    per tick, and the hand-off g -> g+1 is the same neighbor ppermute as
+    GPipe — when device pp-1 wraps to device 0 the receiver just indexes
+    its next chunk. The drain bubble is paid once per batch, giving the
+    (pp-1)/(v*m + pp-1) fraction above. Backward is jax.grad's transpose
+    of the scan, as in the GPipe path.
+    """
+    from ..models.llama import _rope_tables, run_layer_stack
+
+    import numpy as np
+
+    B, S = ids.shape
+    sin, cos = _rope_tables(cfg, S)
+    x = jnp.take(params["embed"], ids, axis=0)
+    assert B % n_micro == 0, (B, n_micro)
+    mb = B // n_micro
+    H = x.shape[-1]
+    layers = params["layers"]
+    L = jax.tree_util.tree_leaves(layers)[0].shape[0]
+
+    # device d's pp-shard is a CONTIGUOUS layer block, but global stage
+    # g = c*pp + d must hold layer chunk g: permute chunks so local
+    # position (d, c) carries global chunk c*pp + d (grad transposes the
+    # gather back automatically)
+    pp_deg = dict(zip(mesh.axis_names,
+                      np.asarray(mesh.devices).shape))["pp"]
+    n_chunks = pp_deg * v
+    assert L % n_chunks == 0, (L, pp_deg, v)
+    perm = jnp.asarray([c * pp_deg + d for d in range(pp_deg)
+                        for c in range(v)])
+
+    def _reorder(a):
+        ck = a.reshape(n_chunks, a.shape[0] // n_chunks, *a.shape[1:])
+        return ck[perm].reshape(a.shape)
+
+    layers = jax.tree_util.tree_map(_reorder, layers)
+
+    def stage_body(layers_local, x_stack, sin_, cos_):
+        pp = lax.axis_size("pp")
+        d = lax.axis_index("pp")
+        assert n_micro % pp == 0, (n_micro, pp)
+        k_groups = n_micro // pp
+        # layers_local: [L/pp, ...] -> [v, L/(pp*v), ...] virtual chunks
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape(v, a.shape[0] // v, *a.shape[1:]),
+            layers_local)
+        # ONE fused scan over all groups: work index r = t - d
+        # decomposes as (group, chunk, micro) = (r//(v*pp), (r%(v*pp))
+        # //pp, r%pp); groups stream back-to-back so the (pp-1)-tick
+        # drain bubble is paid once per batch, not once per group
+        T = k_groups * v * pp + pp - 1
+
+        def tick(carry, t):
+            state, outputs, aux = carry
+            r = t - d
+            active = (r >= 0) & (r < k_groups * v * pp)
+            rr = jnp.clip(r, 0, k_groups * v * pp - 1)
+            gi = rr // (v * pp)
+            c = (rr % (v * pp)) // pp               # virtual chunk
+            m_global = gi * pp + (rr % pp)          # micro index
+            is_entry = (d == 0) & (c == 0)
+            x_in = jnp.where(is_entry, x_stack[m_global], state)
+            chunk_layers = jax.tree_util.tree_map(
+                lambda a: a[c], chunked)
+            y, a = run_layer_stack(cfg, chunk_layers, x_in, sin_, cos_)
+            aux = aux + jnp.where(active, a, 0.0)
+            is_exit = (d == pp - 1) & (c == v - 1) & active
+            upd = lax.dynamic_update_index_in_dim(outputs, y, m_global, 0)
+            outputs = jnp.where(is_exit, upd, outputs)
+            state = lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            return (state, outputs, aux), None
+
+        carry0 = (jnp.zeros((mb, S, H), x_stack.dtype),
+                  jnp.zeros((n_micro, mb, S, H), x_stack.dtype),
+                  jnp.zeros((), jnp.float32))
+        (_, outputs, aux), _ = lax.scan(tick, carry0, jnp.arange(T))
+        outputs = lax.psum(
+            jnp.where(d == pp - 1, outputs, jnp.zeros_like(outputs)),
+            "pp")
+        aux = lax.psum(aux, "pp")
+        return outputs, aux
+
+    layer_manual_specs = jax.tree_util.tree_map(lambda a: P("pp"), layers)
+    x_mb = x.reshape(n_micro, mb, S, H)
+    outputs, aux = jax.shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(layer_manual_specs, P(), P(), P()),
+        out_specs=(P(), P()),
+        axis_names={"pp"}, check_vma=False)(layers, x_mb, sin, cos)
+    h = outputs.reshape(B, S, H)
+    return h, aux
+
+
+def pipeline_interleaved_loss_fn(cfg, mesh, n_micro, v, params, batch):
+    """Interleaved-schedule loss (build_train_step schedule
+    "interleaved")."""
+    h, aux = pipeline_interleaved_forward(cfg, mesh, n_micro, v, params,
+                                          batch["input_ids"])
+    return _head_loss(cfg, params, h, batch["labels"], aux)

@@ -180,10 +180,16 @@ def _has_blocker(stmts) -> bool:
 
 class _Rewriter(ast.NodeTransformer):
     """Rewrites if/while statements into helper calls with generated
-    closures. Fresh names are prefixed __pt_ to stay out of user space."""
+    closures. Fresh names are prefixed __pt_ to stay out of user space.
 
-    def __init__(self):
+    global_names: names declared `global` anywhere at this function's
+    scope — they can't be threaded as closure parameters (the seed would
+    shadow and the cleanup would delete the module binding), so blocks
+    assigning them are left unconverted."""
+
+    def __init__(self, global_names=()):
         self.counter = 0
+        self.global_names = set(global_names)
 
     def _fresh(self, kind):
         self.counter += 1
@@ -195,11 +201,15 @@ class _Rewriter(ast.NodeTransformer):
             posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
             vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
             defaults=[])
+        # re-seed before returning: a nested rewrite's cleanup may have
+        # `del`eted a name inside this closure (else-less elif chains);
+        # the sentinel flows out and the OUTER cleanup deletes it again
+        reseed = [self._seed_stmt(n) for n in ret_names]
         ret = ast.Return(value=ast.Tuple(
             elts=[ast.Name(id=n, ctx=ast.Load()) for n in ret_names],
             ctx=ast.Load()))
         return ast.FunctionDef(name=name, args=args,
-                               body=list(body_stmts) + [ret],
+                               body=list(body_stmts) + reseed + [ret],
                                decorator_list=[], returns=None,
                                type_params=[])
 
@@ -244,7 +254,7 @@ class _Rewriter(ast.NodeTransformer):
         if _has_blocker(node.body) or _has_blocker(node.orelse):
             return node
         names = _assigned_names(node.body + node.orelse)
-        if not names:
+        if not names or any(n in self.global_names for n in names):
             return node
         tname, fname = self._fresh("true"), self._fresh("false")
         stmts = [self._seed_stmt(n) for n in names]
@@ -269,7 +279,7 @@ class _Rewriter(ast.NodeTransformer):
         if node.orelse or _has_blocker(node.body):
             return node
         names = _assigned_names(node.body)
-        if not names:
+        if not names or any(n in self.global_names for n in names):
             return node
         cname, bname = self._fresh("cond"), self._fresh("body")
         stmts = [self._seed_stmt(n) for n in names]
@@ -341,7 +351,14 @@ def convert_to_static(fn: Callable) -> Callable:
         return fn
     fdef.decorator_list = []
 
-    rewriter = _Rewriter()
+    # names declared `global` anywhere in this function (not in nested
+    # defs) must never be threaded through generated closures
+    global_names = set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Global):
+            global_names.update(node.names)
+
+    rewriter = _Rewriter(global_names)
     new_tree = rewriter.visit(tree)
     if rewriter.counter == 0:
         return fn  # nothing converted — keep the original object

@@ -310,7 +310,8 @@ def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None):
 # ---------------------------------------------------------------------------
 
 def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
-                     n_microbatches=None, zero=True, schedule="gpipe"):
+                     n_microbatches=None, zero=True, schedule="gpipe",
+                     virtual_pp=None):
     """Compiled full training step over the hybrid mesh.
 
     Returns (step_fn, init_fn):
@@ -324,6 +325,13 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     residency — reference pipeline_parallel.py:228).
     """
     import optax
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}; expected 'gpipe', "
+            "'1f1b' or 'interleaved'")
+    if virtual_pp is not None and schedule != "interleaved":
+        raise ValueError(
+            "virtual_pp only applies to schedule='interleaved'")
     mesh = topo.mesh
     pp = topo.pp_degree
     use_pp = (pp > 1) if use_pp is None else use_pp
@@ -338,6 +346,14 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
             total, ce, grads = pipeline_1f1b_value_and_grad(
                 cfg, mesh, n_microbatches or pp, params, batch)
             return (total, ce), grads
+    elif use_pp and schedule == "interleaved":
+        from ..distributed.pipeline import pipeline_interleaved_loss_fn
+        # virtual stages per device: as many 2-chunk splits as the layer
+        # count allows (the reference's virtual_pp_degree)
+        v = virtual_pp or (2 if cfg.num_hidden_layers % (pp * 2) == 0
+                           else 1)
+        loss = functools.partial(pipeline_interleaved_loss_fn, cfg, mesh,
+                                 n_microbatches or pp, v)
     elif use_pp:
         from ..distributed.pipeline import pipeline_loss_fn
         loss = functools.partial(pipeline_loss_fn, cfg, mesh,

@@ -214,3 +214,46 @@ def test_closure_function_falls_back():
         return y
 
     assert convert_to_static(f) is f  # closures keep plain tracing
+
+
+_LR = 0.1
+
+
+def test_global_assigned_in_branch_not_corrupted():
+    def g(x, warm):
+        global _LR
+        if warm:
+            _LR = 0.01
+            y = x * 1.0
+        else:
+            y = x * 2.0
+        if x.sum() > 100.0:   # a convertible if keeps conversion active
+            z = x * 0.0
+        else:
+            z = y
+        return z
+
+    conv = convert_to_static(g)
+    assert conv is not g  # the second if converted...
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(conv(x, False).numpy(), [2.0])
+    assert _LR == 0.1  # ...but the global-assigning one was left alone
+    np.testing.assert_allclose(conv(x, True).numpy(), [1.0])
+    assert _LR == 0.01  # python `if` semantics preserved for the global
+    globals()["_LR"] = 0.1
+
+
+def test_elif_chain_no_branch_taken():
+    def f(x, p1, p2):
+        if p1:
+            y = 1.0
+        elif p2:
+            y = 2.0
+        return x
+
+    conv = convert_to_static(f)
+    assert conv is not f
+    x = paddle.to_tensor(np.array([5.0], np.float32))
+    # neither branch assigns y; y is never used — must not crash
+    np.testing.assert_allclose(conv(x, False, False).numpy(), [5.0])
+    np.testing.assert_allclose(conv(x, True, False).numpy(), [5.0])
