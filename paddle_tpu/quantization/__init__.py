@@ -1,7 +1,14 @@
 """paddle.quantization parity — QAT/PTQ over pure XLA-fused fake-quant.
 
 Reference: python/paddle/quantization/ (QuantConfig, QAT, quanters) and
-python/paddle/quantization/imperative (ImperativePTQ)."""
+python/paddle/quantization/imperative (ImperativePTQ).
+
+Export scope note: the reference's ONNX-format quantized-model export
+(paddle2onnx path) is out of scope here — no onnx runtime exists in this
+environment, and the TPU serving boundary is the StableHLO artifact
+jit.save produces. A converted (fake-quant-folded) model exports through
+jit.save like any other; quantized-operator interchange beyond that
+rides StableHLO's quantized types when a consumer needs it."""
 from .functional import (  # noqa: F401
     fake_quant_dequant, quant_tensor, dequant_tensor)
 from .quanters import (  # noqa: F401
