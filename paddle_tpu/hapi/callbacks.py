@@ -89,3 +89,77 @@ class LRScheduler(Callback):
         super().__init__()
         self.by_step = by_step
         self.by_epoch = by_epoch
+
+
+class ScalarWriter:
+    """Append-only JSONL scalar log — the VisualDL LogWriter analog
+    (reference: VisualDLCallback in python/paddle/hapi/callbacks.py:772
+    writing via visualdl.LogWriter). JSONL instead of the VisualDL
+    protobuf format: no service dependency, trivially consumed by pandas
+    or a TensorBoard converter."""
+
+    def __init__(self, logdir):
+        import os
+        os.makedirs(logdir, exist_ok=True)
+        self._path = os.path.join(logdir, "scalars.jsonl")
+        self._f = open(self._path, "a", buffering=1)
+
+    def add_scalar(self, tag, value, step):
+        import json
+        self._f.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+
+    def close(self):
+        self._f.close()
+
+
+class VisualDL(Callback):
+    """Scalar-logging callback (reference callbacks.py:772 VisualDL):
+    records per-step train metrics and per-epoch eval metrics through
+    ScalarWriter, plus device memory stats when the backend exposes
+    them."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._step = 0
+
+    def _w(self):
+        if self._writer is None:
+            self._writer = ScalarWriter(self.log_dir)
+        return self._writer
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._w().add_scalar(f"train/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats() or {}
+            if "bytes_in_use" in stats:
+                self._w().add_scalar("sys/bytes_in_use",
+                                     stats["bytes_in_use"], self._step)
+        except Exception:
+            pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        # epoch logs mix TRAIN epoch means with eval_* results; keep the
+        # namespaces separate so eval curves really are eval
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)) and len(v) == 1:
+                v = v[0]
+            tag = (f"eval/{k[5:]}" if k.startswith("eval_")
+                   else f"train_epoch/{k}")
+            try:
+                self._w().add_scalar(tag, float(v), epoch)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None

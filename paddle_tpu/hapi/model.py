@@ -90,14 +90,50 @@ class Model:
         eval_loader = self._as_loader(eval_data, batch_size, False, False,
                                       num_workers) if eval_data is not None \
             else None
+        cbs = CallbackList(callbacks)
+        for cb in cbs.callbacks:
+            cb.set_model(self)
+            cb.set_params({"epochs": epochs, "batch_size": batch_size,
+                           "verbose": verbose, "metrics": [
+                               m.name() for m in self._metrics]})
         history = {"loss": []}
+        self.stop_training = False
+        it = 0
+        cbs.on_train_begin()
+        try:
+            self._fit_loop(loader, eval_loader, epochs, eval_freq,
+                           save_dir, save_freq, verbose, log_freq,
+                           accumulate_grad_batches, num_iters, history,
+                           cbs)
+        finally:
+            cbs.on_train_end({"loss": history["loss"][-1]
+                              if history["loss"] else None})
+        return history
+
+    def _metric_logs(self):
+        logs = {}
+        for m in self._metrics:
+            name, val = m.name(), m.accumulate()
+            if isinstance(name, (list, tuple)):  # multi-topk Accuracy
+                vals = val if isinstance(val, (list, tuple)) \
+                    else [val] * len(name)
+                logs.update(dict(zip(name, vals)))
+            else:
+                logs[name] = val
+        return logs
+
+    def _fit_loop(self, loader, eval_loader, epochs, eval_freq, save_dir,
+                  save_freq, verbose, log_freq, accumulate_grad_batches,
+                  num_iters, history, cbs):
         it = 0
         for epoch in range(epochs):
             for m in self._metrics:
                 m.reset()
+            cbs.on_epoch_begin(epoch)
             t0 = time.time()
             epoch_losses = []
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
                 ins, labs = self._split_batch(batch)
                 res = self.train_batch(ins, labs,
                                        update=(step + 1)
@@ -105,6 +141,9 @@ class Model:
                 loss_vals = res[0] if isinstance(res, tuple) else res
                 epoch_losses.append(loss_vals[0])
                 it += 1
+                logs = {"loss": float(loss_vals[0])}
+                logs.update(self._metric_logs())
+                cbs.on_train_batch_end(step, logs)
                 if verbose and step % log_freq == 0:
                     msg = (f"Epoch {epoch + 1}/{epochs} step {step} "
                            f"loss: {loss_vals[0]:.4f}")
@@ -117,16 +156,25 @@ class Model:
                     self._optimizer._lr, "step"):
                 self._optimizer._lr.step()
             history["loss"].append(float(np.mean(epoch_losses)))
+            epoch_logs = {"loss": history["loss"][-1]}
+            epoch_logs.update(self._metric_logs())
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, verbose=verbose)
+                eval_res = self.evaluate(eval_loader, verbose=verbose)
+                if isinstance(eval_res, dict):
+                    epoch_logs.update({
+                        f"eval_{k}": (v[0] if isinstance(v, (list, tuple))
+                                      and len(v) == 1 else v)
+                        for k, v in eval_res.items()})
+            cbs.on_epoch_end(epoch, epoch_logs)
             if save_dir is not None and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
             if verbose:
                 print(f"Epoch {epoch + 1} done in {time.time() - t0:.1f}s "
                       f"mean loss {history['loss'][-1]:.4f}", flush=True)
+            if self.stop_training:  # EarlyStopping contract
+                break
             if num_iters is not None and it >= num_iters:
                 break
-        return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -78,3 +78,87 @@ def test_device_surface():
     keep = paddle.ones([256, 256])
     assert dev.cuda.max_memory_allocated() >= 0
     del keep
+
+
+def test_hapi_callbacks_invoked_and_visualdl_logs(tmp_path):
+    """fit() drives the callback protocol (reference hapi/model.py fit →
+    CallbackList) and the VisualDL analog writes scalars."""
+    import json
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback, VisualDL
+    from paddle_tpu.vision.datasets import MNIST
+
+    events = []
+
+    class Probe(Callback):
+        def on_train_begin(self, logs=None):
+            events.append("train_begin")
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(("epoch_end", sorted(logs)))
+
+        def on_train_batch_end(self, step, logs=None):
+            events.append("batch_end")
+
+        def on_train_end(self, logs=None):
+            events.append("train_end")
+
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model = Model(net)
+    model.prepare(opt, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    vdl = VisualDL(log_dir=str(tmp_path))
+    model.fit(MNIST(backend="synthetic"), batch_size=64, epochs=1,
+              callbacks=[Probe(), vdl], verbose=0, num_iters=4)
+    assert events[0] == "train_begin" and events[-1] == "train_end"
+    assert events.count("batch_end") == 4
+    lines = [json.loads(l)
+             for l in open(tmp_path / "scalars.jsonl")]
+    assert any(l["tag"] == "train/loss" for l in lines)
+
+
+def test_early_stopping_halts_fit():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.vision.datasets import MNIST
+
+    class StopNow(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            self.model.stop_training = True
+
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model = Model(net)
+    model.prepare(opt, nn.CrossEntropyLoss())
+    h = model.fit(MNIST(backend="synthetic"), batch_size=64, epochs=5,
+                  callbacks=[StopNow()], verbose=0, num_iters=None)
+    assert len(h["loss"]) == 1  # stopped after the first epoch
+
+
+def test_fit_with_multi_topk_accuracy_and_eval_logging(tmp_path):
+    import json
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import VisualDL
+    from paddle_tpu.vision.datasets import MNIST
+
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model = Model(net)
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy(topk=(1, 5)))
+    vdl = VisualDL(log_dir=str(tmp_path))
+    ds = MNIST(backend="synthetic")
+    model.fit(ds, eval_data=ds, batch_size=64, epochs=1,
+              callbacks=[vdl], verbose=0, num_iters=3)
+    lines = [json.loads(l) for l in open(tmp_path / "scalars.jsonl")]
+    tags = {l["tag"] for l in lines}
+    assert "train/acc_top1" in tags and "train/acc_top5" in tags
+    assert "eval/loss" in tags          # eval namespace is really eval
+    assert "train_epoch/loss" in tags   # train means are not mislabeled
+    assert "train/step" not in tags
