@@ -1,0 +1,27 @@
+"""Shared placement helpers for model train-step builders
+(models.llama, models.ernie)."""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["sharding_tree", "replicate_scalars"]
+
+
+def sharding_tree(mesh, tree_specs):
+    """PartitionSpec pytree -> NamedSharding pytree on `mesh`."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree_specs,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+def replicate_scalars(mesh, tree):
+    """device_put scalar leaves replicated over the mesh. Optimizer
+    states created by jit leave scalars (Adam count) on one device; a
+    state tree with inconsistent device assignments is rejected by jit
+    once the leaves are committed (e.g. after a checkpoint restore)."""
+    def place(x):
+        if hasattr(x, "shape") and getattr(x, "ndim", None) == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        return x
+    return jax.tree_util.tree_map(place, tree)

@@ -362,12 +362,8 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
         def loss(params, batch):
             return loss_fn(cfg, params, batch, sp_axis="mp")
 
-    def sharding_tree(tree_specs):
-        return jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), tree_specs,
-            is_leaf=lambda s: isinstance(s, P))
-
-    param_sh = sharding_tree(specs)
+    from ._sharding_utils import sharding_tree
+    param_sh = sharding_tree(mesh, specs)
 
     def zero_shard_spec(spec, shape):
         # ZeRO-1: shard the largest unsharded dim of each optimizer-state
