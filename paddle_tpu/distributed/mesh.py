@@ -35,21 +35,24 @@ class HybridTopology:
     (reference topology.py uses ["data","pipe","sharding","model"]).
     """
 
-    AXES = ("dp", "pp", "sharding", "mp")
+    AXES = ("dp", "pp", "sharding", "sp", "mp")
 
-    def __init__(self, dp=1, pp=1, sharding=1, mp=1, devices=None):
+    def __init__(self, dp=1, pp=1, sharding=1, mp=1, devices=None, sp=1):
         devices = devices if devices is not None else jax.devices()
-        want = dp * pp * sharding * mp
+        want = dp * pp * sharding * sp * mp
         if want > len(devices):
             raise ValueError(
-                f"topology {dp}x{pp}x{sharding}x{mp}={want} needs more than "
-                f"{len(devices)} devices")
+                f"topology {dp}x{pp}x{sharding}x{sp}x{mp}={want} needs "
+                f"more than {len(devices)} devices")
         if want < len(devices) and dp == 1 and want == 1:
             dp = len(devices)  # default pure-DP over all devices
             want = dp
         devices = devices[:want]
-        self.dims = {"dp": dp, "pp": pp, "sharding": sharding, "mp": mp}
-        dev_array = np.asarray(devices).reshape(dp, pp, sharding, mp)
+        # "sp" (sequence/context parallel — ring attention) sits next to
+        # "mp" so the ring's neighbor ppermute rides adjacent ICI links
+        self.dims = {"dp": dp, "pp": pp, "sharding": sharding, "sp": sp,
+                     "mp": mp}
+        dev_array = np.asarray(devices).reshape(dp, pp, sharding, sp, mp)
         self.mesh = Mesh(dev_array, axis_names=self.AXES)
 
     # -- fleet-API parity ---------------------------------------------------
@@ -75,6 +78,10 @@ class HybridTopology:
         return self.dims["sharding"]
 
     @property
+    def sp_degree(self):
+        return self.dims["sp"]
+
+    @property
     def mp_degree(self):
         return self.dims["mp"]
 
@@ -85,8 +92,9 @@ class HybridTopology:
         return NamedSharding(self.mesh, PartitionSpec(*axes))
 
 
-def init_mesh(dp=1, pp=1, sharding=1, mp=1, devices=None) -> HybridTopology:
-    topo = HybridTopology(dp, pp, sharding, mp, devices)
+def init_mesh(dp=1, pp=1, sharding=1, mp=1, devices=None,
+              sp=1) -> HybridTopology:
+    topo = HybridTopology(dp, pp, sharding, mp, devices, sp=sp)
     _GLOBAL_TOPO[0] = topo
     _GLOBAL_MESH[0] = topo.mesh
     return topo

@@ -37,6 +37,12 @@ def _block_attn(q, k, v, scale, q_off, k_off, causal):
     m,l: [B, H, Sq] f32 and acc: [B, H, Sq, D] f32 (un-normalized).
     q_off/k_off: global offsets of the blocks for causal masking.
     """
+    if k.shape[2] != q.shape[2]:
+        # GQA: expand kv heads at USE time only — the ring rotates the
+        # small nkv blocks, not nh/nkv redundant copies
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)   # [B,H,Sq,D]
     kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -125,10 +131,15 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
 
 
 def _sharded(fn, mesh, axis_name):
+    # manualize ONLY the sequence axis: on a hybrid mesh the batch dim
+    # stays dp-sharded and the head dim mp-sharded in the auto (GSPMD)
+    # sense — full-mesh manualization would all-gather both and run the
+    # attention redundantly on every dp/mp slice
     spec = P(None, axis_name, None, None)
     return jax.shard_map(fn, mesh=mesh,
                          in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+                         out_specs=spec, axis_names={axis_name},
+                         check_vma=False)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name: str = "sp",

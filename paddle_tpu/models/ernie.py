@@ -172,18 +172,32 @@ def forward_pure(cfg: ErnieConfig, params, input_ids,
 
 
 def pretrain_loss(cfg: ErnieConfig, params, batch):
-    """MLM (ignore_index = -1 on unmasked positions) + NSP/SOP loss.
+    """MLM + NSP/SOP loss.
 
-    batch: input_ids, token_type_ids, attention_mask, mlm_labels [B,S]
-    (-1 where not predicted), nsp_labels [B]."""
+    batch: input_ids, token_type_ids, attention_mask, nsp_labels [B],
+    and EITHER
+      masked_positions [B, P] + masked_labels [B, P] (-1 pads) —
+      the reference's pretraining input format: the MLM head runs only
+      on the ~15% predicted positions, shrinking the dominant [.., V]
+      fp32 activation by ~1/mask_rate;
+    OR mlm_labels [B, S] (-1 on unpredicted positions) — the dense
+      fallback for simple callers.
+    """
     seq, pooled = forward_pure(
         cfg, params, batch["input_ids"], batch.get("token_type_ids"),
         batch.get("attention_mask"))
-    h = jax.nn.gelu(seq @ params["mlm_trans_w"] + params["mlm_trans_b"])
+    if "masked_positions" in batch:
+        pos = batch["masked_positions"]          # [B, P]
+        labels = batch["masked_labels"]          # [B, P], -1 padded
+        sel = jnp.take_along_axis(
+            seq, jnp.maximum(pos, 0)[..., None], axis=1)  # [B, P, H]
+    else:
+        labels = batch["mlm_labels"]             # [B, S]
+        sel = seq
+    h = jax.nn.gelu(sel @ params["mlm_trans_w"] + params["mlm_trans_b"])
     h = _ln(h, params["mlm_ln_w"], params["mlm_ln_b"], cfg.layer_norm_eps)
     logits = (h @ params["word_emb"].T + params["mlm_bias"]).astype(
         jnp.float32)  # tied decoder
-    labels = batch["mlm_labels"]
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
     lse = jax.nn.logsumexp(logits, axis=-1)
@@ -227,20 +241,27 @@ def build_pretrain_step(cfg: ErnieConfig, topo, optimizer=None):
         return params, opt_state, metrics
 
     data_sh = NamedSharding(mesh, P("dp", None))
-    batch_sh = {"input_ids": data_sh, "token_type_ids": data_sh,
-                "attention_mask": data_sh, "mlm_labels": data_sh,
-                "nsp_labels": NamedSharding(mesh, P("dp"))}
-    step_jit = jax.jit(step, in_shardings=(param_sh, None, batch_sh),
-                       out_shardings=(param_sh, None, None),
-                       donate_argnums=(0, 1))
+    vec_sh = NamedSharding(mesh, P("dp"))
+    _jits: Dict[Any, Any] = {}
 
     def step_fn(params, opt_state, batch):
         # the compiled contract needs every key; default the optional
-        # ones the way pretrain_loss would
+        # ones the way pretrain_loss would. One jit specialization per
+        # batch-key set (dense mlm_labels vs masked_positions format).
         ids = batch["input_ids"]
         batch = dict(batch)
         batch.setdefault("token_type_ids", jnp.zeros_like(ids))
         batch.setdefault("attention_mask", jnp.ones_like(ids))
+        keys = frozenset(batch)
+        step_jit = _jits.get(keys)
+        if step_jit is None:
+            batch_sh = {k: (vec_sh if batch[k].ndim == 1 else data_sh)
+                        for k in batch}
+            step_jit = jax.jit(step,
+                               in_shardings=(param_sh, None, batch_sh),
+                               out_shardings=(param_sh, None, None),
+                               donate_argnums=(0, 1))
+            _jits[keys] = step_jit
         with mesh:
             return step_jit(params, opt_state, batch)
     return step_fn, init_fn

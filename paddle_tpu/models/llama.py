@@ -182,7 +182,8 @@ def _rms_norm(x, w, eps):
     return (x32 * lax.rsqrt(ms + eps)).astype(x.dtype) * w
 
 
-def _attention(cfg: LlamaConfig, lp, x, sin, cos):
+def _attention(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
+               cp_axis="sp"):
     B, S, H = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, \
         cfg.head_dim
@@ -191,13 +192,21 @@ def _attention(cfg: LlamaConfig, lp, x, sin, cos):
     v = (x @ lp["wv"]).reshape(B, S, nkv, d)
     q = _apply_rope(q, sin, cos)
     k = _apply_rope(k, sin, cos)
-    if nkv != nh:  # grouped-query attention: repeat kv heads
-        rep = nh // nkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    # flash-attention via Pallas when available; jnp fallback (XLA fuses)
-    from ..ops import pallas_ops
-    out = pallas_ops.causal_attention(q, k, v)
+    if cp_mesh is not None:
+        # context parallel: sequence sharded over cp_axis, K/V blocks
+        # rotate the ring (distributed.sequence_parallel) — exact causal
+        # attention at O(S/n) memory per device. GQA expansion happens
+        # inside the ring's block compute, so only nkv heads rotate.
+        from ..distributed.sequence_parallel import ring_attention_sharded
+        out = ring_attention_sharded(q, k, v, cp_mesh, cp_axis)
+    else:
+        if nkv != nh:  # grouped-query attention: repeat kv heads
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        # flash-attention via Pallas when available; jnp fallback
+        from ..ops import pallas_ops
+        out = pallas_ops.causal_attention(q, k, v)
     return out.reshape(B, S, H) @ lp["wo"]
 
 
@@ -250,10 +259,11 @@ def _moe_mlp(cfg: LlamaConfig, lp, x):
     return out.reshape(B, S, H), aux
 
 
-def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos):
+def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
+                  cp_axis="sp"):
     """One decoder block on a per-layer param slice (no leading L axis)."""
     h = x + _attention(cfg, lp, _rms_norm(x, lp["ln1"], cfg.rms_norm_eps),
-                       sin, cos)
+                       sin, cos, cp_mesh=cp_mesh, cp_axis=cp_axis)
     normed = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
     if cfg.moe_num_experts > 0:
         mlp_out, aux = _moe_mlp(cfg, lp, normed)
@@ -261,17 +271,21 @@ def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos):
     return h + _dense_mlp(lp, normed), jnp.zeros((), jnp.float32)
 
 
-def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos):
+def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos,
+                    cp_mesh=None, cp_axis="sp"):
     """lax.scan over the stacked layer axis (compiler-friendly sequential
     control flow; remat per layer = the recompute strategy)."""
+    layer_fn = functools.partial(decoder_layer, cp_mesh=cp_mesh,
+                                 cp_axis=cp_axis)
+
     def body(carry, lp):
         h, aux = carry
-        fn = decoder_layer
+        fn = layer_fn
         if cfg.use_remat:
             policy = None  # "full": save nothing, recompute the layer
             if cfg.remat_policy == "dots":
                 policy = jax.checkpoint_policies.dots_saveable
-            fn = jax.checkpoint(decoder_layer, static_argnums=(0,),
+            fn = jax.checkpoint(layer_fn, static_argnums=(0,),
                                 policy=policy)
         h, a = fn(cfg, lp, h, sin, cos)
         return (h, aux + a), None
@@ -279,23 +293,36 @@ def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos):
     return x, aux
 
 
-def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None):
+def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None,
+                 cp_mesh=None, cp_axis="sp"):
     """Full forward: ids -> logits (fp32). sp_axis: mesh axis name to shard
-    the sequence dimension of activations on (sequence parallelism)."""
+    the sequence dimension of activations on (Megatron-style sequence
+    parallelism for the elementwise/norm work). cp_mesh: enable ring-
+    attention context parallelism over the mesh's 'sp' axis — sequence
+    sharded end to end, exact causal attention at O(S/sp) memory."""
     B, S = input_ids.shape
     sin, cos = _rope_tables(cfg, S)
     x = jnp.take(params["embed"], input_ids, axis=0)
-    if sp_axis is not None:
+    if cp_mesh is not None:
+        # pin ONLY the sequence dim: UNCONSTRAINED (not None — None means
+        # replicated) leaves batch/hidden placement to GSPMD, so dp batch
+        # sharding survives and no 'dp' axis is required of cp meshes
+        x = lax.with_sharding_constraint(
+            x, P(P.UNCONSTRAINED, cp_axis, P.UNCONSTRAINED))
+    elif sp_axis is not None:
         x = lax.with_sharding_constraint(x, P("dp", sp_axis, None))
-    x, aux = run_layer_stack(cfg, params["layers"], x, sin, cos)
+    x, aux = run_layer_stack(cfg, params["layers"], x, sin, cos,
+                             cp_mesh=cp_mesh, cp_axis=cp_axis)
     x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, aux
 
 
-def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None):
+def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None,
+            cp_mesh=None, cp_axis="sp"):
     ids, labels = batch["input_ids"], batch["labels"]
-    logits, aux = forward_pure(cfg, params, ids, sp_axis)
+    logits, aux = forward_pure(cfg, params, ids, sp_axis, cp_mesh=cp_mesh,
+                               cp_axis=cp_axis)
     # logsumexp form: ce = lse - target_logit. Avoids materializing the
     # full [B, S, V] log-softmax (1 GB fp32 at bench shapes) — XLA fuses
     # the reduction into the lm_head matmul epilogue.
@@ -335,6 +362,10 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     mesh = topo.mesh
     pp = topo.pp_degree
     use_pp = (pp > 1) if use_pp is None else use_pp
+    if use_pp and getattr(topo, "sp_degree", 1) > 1:
+        raise ValueError(
+            "context parallelism (sp > 1) is not supported together "
+            "with pipeline parallelism yet; use sp with dp/mp only")
     opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     specs = param_specs(cfg)
 
@@ -359,7 +390,11 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
         loss = functools.partial(pipeline_loss_fn, cfg, mesh,
                                  n_microbatches or pp)
     else:
+        cp_mesh = mesh if getattr(topo, "sp_degree", 1) > 1 else None
+
         def loss(params, batch):
+            if cp_mesh is not None:  # ring-attention context parallel
+                return loss_fn(cfg, params, batch, cp_mesh=cp_mesh)
             return loss_fn(cfg, params, batch, sp_axis="mp")
 
     from ._sharding_utils import sharding_tree
@@ -388,15 +423,8 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
                 out_shardings=None)(params)
             # re-place opt state with ZeRO sharding
             def place(x, pspec):
-                if not hasattr(x, "shape"):
-                    return x
-                if x.ndim == 0:
-                    # scalars (Adam count etc.) replicate over the mesh —
-                    # leaving them on one device makes the state tree's
-                    # device assignments inconsistent, which jit rejects
-                    # once the leaves are committed (e.g. after a
-                    # checkpoint restore)
-                    return jax.device_put(x, NamedSharding(mesh, P()))
+                if not hasattr(x, "shape") or x.ndim == 0:
+                    return x  # scalars: replicate_scalars below
                 return jax.device_put(
                     x, NamedSharding(mesh, zero_shard_spec(
                         pspec, x.shape)))
@@ -420,6 +448,8 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
 
             opt_state = jax.tree_util.tree_map_with_path(
                 place_leaf, opt_state)
+            from ._sharding_utils import replicate_scalars
+            opt_state = replicate_scalars(mesh, opt_state)
         return params, opt_state
 
     def step(params, opt_state, batch):

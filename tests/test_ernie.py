@@ -112,3 +112,30 @@ def test_sharded_pretrain_step(dp, mp):
     params, opt_state, m = step_fn(params, opt_state, placed)
     assert np.isfinite(float(m["loss"]))
     assert np.isfinite(float(m["mlm"])) and np.isfinite(float(m["nsp"]))
+
+
+def test_masked_positions_format_matches_dense():
+    """The gathered MLM head (masked_positions input format) computes the
+    same loss as the dense mlm_labels path."""
+    cfg = _cfg()
+    params = ernie.init_params(cfg, jax.random.PRNGKey(3))
+    batch = _batch(cfg, B=4, S=16, seed=5)
+    dense_total, dense_parts = ernie.pretrain_loss(cfg, params, batch)
+
+    # convert to the gathered format: fixed P slots, -1 padded
+    lab = np.asarray(batch["mlm_labels"])
+    B, S = lab.shape
+    P_ = 8
+    pos = np.zeros((B, P_), np.int32)
+    plab = np.full((B, P_), -1, np.int32)
+    for b in range(B):
+        where = np.nonzero(lab[b] >= 0)[0][:P_]
+        pos[b, :len(where)] = where
+        plab[b, :len(where)] = lab[b][where]
+        assert (lab[b] >= 0).sum() <= P_, "test config overflow"
+    b2 = {k: v for k, v in batch.items() if k != "mlm_labels"}
+    b2["masked_positions"] = jnp.asarray(pos)
+    b2["masked_labels"] = jnp.asarray(plab)
+    g_total, g_parts = ernie.pretrain_loss(cfg, params, b2)
+    np.testing.assert_allclose(float(g_parts["mlm"]),
+                               float(dense_parts["mlm"]), rtol=1e-5)

@@ -79,3 +79,71 @@ def test_ring_attention_grad_flows():
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-3)
+
+
+def test_context_parallel_train_step_matches_dense():
+    """Ring-attention context parallelism wired into the flagship step:
+    loss on a dp2 x sp2 x mp2 mesh matches the unsharded computation."""
+    from paddle_tpu.distributed.mesh import HybridTopology
+    from paddle_tpu.models.llama import (LlamaConfig, init_params,
+                                         loss_fn, build_train_step)
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64,
+                      dtype=jnp.float32, use_remat=False)
+    topo = HybridTopology(dp=2, pp=1, sharding=1, mp=2, sp=2,
+                          devices=jax.devices()[:8])
+    assert topo.sp_degree == 2
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32),
+    }
+    dense_total, dense_ce = loss_fn(cfg, params := init_params(
+        cfg, jax.random.PRNGKey(0)), batch)
+
+    with topo.mesh:
+        _, cp_ce = jax.jit(
+            lambda p, b: loss_fn(cfg, p, b, cp_mesh=topo.mesh))(params,
+                                                                batch)
+    np.testing.assert_allclose(float(cp_ce), float(dense_ce), rtol=2e-4)
+
+    # and the full train step runs with cp enabled via build_train_step
+    step_fn, init_fn = build_train_step(cfg, topo, use_pp=False)
+    p2, opt_state = init_fn(jax.random.PRNGKey(0))
+    sh = NamedSharding(topo.mesh, P("dp", None))
+    placed = {k: jax.device_put(v, sh) for k, v in batch.items()}
+    p2, opt_state, m = step_fn(p2, opt_state, placed)
+    np.testing.assert_allclose(float(m["ce"]), float(dense_ce), rtol=2e-4)
+
+
+def test_ring_attention_gqa_expands_at_use():
+    """GQA: q has nh heads, k/v only nkv — the ring rotates the small
+    blocks and expands inside the block compute."""
+    rng = np.random.default_rng(3)
+    B, S, nh, nkv, D = 2, 32, 8, 2, 8
+    q = jnp.asarray(rng.standard_normal((B, S, nh, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, nkv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, nkv, D)), jnp.float32)
+    kf = jnp.repeat(k, nh // nkv, axis=2)
+    vf = jnp.repeat(v, nh // nkv, axis=2)
+    ref = _attention_jnp(q, kf, vf)
+    mesh = _mesh(4)
+    out = jax.jit(lambda a, b, c: ring_attention_sharded(
+        a, b, c, mesh, "sp"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_cp_with_pp_raises():
+    from paddle_tpu.distributed.mesh import HybridTopology
+    from paddle_tpu.models.llama import LlamaConfig, build_train_step
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=32,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=32,
+                      dtype=jnp.float32, use_remat=False)
+    topo = HybridTopology(dp=1, pp=2, sharding=1, mp=1, sp=2,
+                          devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="context parallelism"):
+        build_train_step(cfg, topo)
