@@ -65,6 +65,21 @@ def main():
             dtype=jnp.float32, use_remat=False)
         S, iters = 256, 3
 
+    tuned_blocks = None
+    if on_tpu:
+        # Autotune the flash-attention block sizes for the bench shape
+        # before the step is traced (phi/kernels/autotune analog). Bounded
+        # and best-effort: a tuning failure must never cost the number.
+        try:
+            from paddle_tpu.ops import pallas_ops
+            tuned_blocks = pallas_ops.tune_causal_attention(
+                B=4, S=S, H=base["num_attention_heads"],
+                D=base["hidden_size"] // base["num_attention_heads"],
+                dtype=jnp.bfloat16, budget_s=300, iters=30, verbose=True)
+            sys.stderr.write(f"bench: tuned flash blocks {tuned_blocks}\n")
+        except Exception as e:
+            sys.stderr.write(f"bench: autotune skipped: {e}\n")
+
     def run_variant(policy, B):
         cfg = LlamaConfig(remat_policy=policy, **base)
         params = init_params(cfg, jax.random.PRNGKey(0))
@@ -163,6 +178,8 @@ def main():
             "device": getattr(dev, "device_kind", str(dev)),
             "batch": B, "seq": S,
             "attention": "pallas_flash" if used_flash else "xla_jnp",
+            "flash_blocks": (list(tuned_blocks)
+                             if (tuned_blocks and used_flash) else None),
             "remat_policy": cfg.remat_policy if cfg.use_remat else "none",
         },
     }

@@ -2,4 +2,5 @@
 from . import nn
 from . import autograd
 from . import asp
+from . import autotune
 from . import optimizer

@@ -1,0 +1,14 @@
+"""paddle.incubate.autotune parity.
+
+Reference: python/paddle/incubate/autotune.py::set_config — accepts a dict
+or JSON-file path with a {"kernel": {"enable": bool}} section and flips
+the global autotune switch (C++ side: phi/kernels/autotune/switch_autotune).
+Here the switch gates the measured block-size selection for Pallas kernels
+(paddle_tpu.ops.autotune); the reference's "tuning_range" (which steps of
+the run to tune on) does not apply because tuning runs eagerly before the
+step is compiled, so it is accepted and ignored.
+"""
+from paddle_tpu.ops.autotune import (  # noqa: F401
+    set_config, enabled, save, load, cache_stats)
+
+__all__ = ["set_config", "enabled", "save", "load", "cache_stats"]

@@ -1,0 +1,161 @@
+"""Kernel autotuning: measured config selection with a persistent cache.
+
+Reference analog: paddle/phi/kernels/autotune/ (cache.h `KernelCallback`
+result cache keyed by op + shape signature; switch_autotune.cc turns
+tuning on/off globally) and the Python face
+python/paddle/incubate/autotune.py::set_config.
+
+TPU-native shape: tuning happens **eagerly, outside jit** — candidates are
+compiled and timed as standalone executables, the winner is recorded in a
+process-global cache, and jitted graphs read the cached choice at trace
+time (a static Python value, so the compiled program bakes in the tuned
+block sizes; re-tracing after tuning picks up new winners). This replaces
+the reference's exhaustive-search-on-first-run flow, which cannot work
+inside an XLA-compiled step.
+
+The cache can be persisted to JSON (`save`/`load`, or automatically via
+``PADDLE_TPU_AUTOTUNE_CACHE=<path>``) so a separate warmup job can ship
+tuned configs to production runs, like the reference's autotune cache
+serialization.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+__all__ = ["set_config", "enabled", "lookup", "record", "tune",
+           "save", "load", "time_callable", "cache_stats"]
+
+# op_name -> {key(str): config(list|tuple)}
+_CACHE: dict = {}
+_HITS = 0
+_MISSES = 0
+_ENABLED = None  # tri-state: None = follow FLAGS_use_autotune
+
+
+def _flag_default() -> bool:
+    try:
+        from paddle_tpu.core.flags import flag
+        return bool(flag("FLAGS_use_autotune"))
+    except Exception:
+        return True
+
+
+def enabled() -> bool:
+    return _flag_default() if _ENABLED is None else _ENABLED
+
+
+def set_config(config=None):
+    """Mirror of paddle.incubate.autotune.set_config
+    (python/paddle/incubate/autotune.py): accepts a dict (or a path to a
+    JSON file) with a {"kernel": {"enable": bool}} section. Unknown
+    sections are ignored, as in the reference."""
+    global _ENABLED
+    if config is None:
+        _ENABLED = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    kernel = config.get("kernel", {})
+    if "enable" in kernel:
+        _ENABLED = bool(kernel["enable"])
+
+
+def _key_str(key) -> str:
+    return json.dumps(key, default=str) if not isinstance(key, str) else key
+
+
+def lookup(op_name: str, key):
+    global _HITS, _MISSES
+    cfg = _CACHE.get(op_name, {}).get(_key_str(key))
+    if cfg is None:
+        _MISSES += 1
+    else:
+        _HITS += 1
+    return tuple(cfg) if isinstance(cfg, list) else cfg
+
+
+def record(op_name: str, key, config):
+    _CACHE.setdefault(op_name, {})[_key_str(key)] = (
+        list(config) if isinstance(config, tuple) else config)
+    path = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+    if path:
+        try:
+            save(path)
+        except OSError:
+            pass
+
+
+def cache_stats():
+    n = sum(len(v) for v in _CACHE.values())
+    return {"size": n, "hits": _HITS, "misses": _MISSES}
+
+
+def save(path: str):
+    with open(path, "w") as f:
+        json.dump(_CACHE, f, indent=1, sort_keys=True)
+
+
+def load(path: str):
+    global _CACHE
+    with open(path) as f:
+        _CACHE.update(json.load(f))
+
+
+def time_callable(fn, args, warmup=1, iters=5):
+    """Median wall-time of ``fn(*args)`` in seconds. Synchronizes by
+    materializing every output to host (np.asarray) — device-agnostic and
+    robust where block_until_ready is not (the axon tunnel)."""
+    import jax
+
+    def _sync(out):
+        for leaf in jax.tree_util.tree_leaves(out):
+            np.asarray(leaf)
+
+    for _ in range(warmup):
+        _sync(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def tune(op_name: str, key, candidates, time_candidate, budget_s=None,
+         verbose=False):
+    """Pick the fastest config from ``candidates`` by measurement.
+
+    ``time_candidate(config) -> seconds`` (raise to disqualify — e.g. the
+    config fails to compile or OOMs VMEM). The winner is recorded in the
+    cache and returned; a prior cached winner short-circuits. ``budget_s``
+    bounds total tuning time: remaining candidates are skipped once spent
+    (the best seen so far still wins)."""
+    cached = lookup(op_name, key)
+    if cached is not None:
+        return cached
+    if not enabled():
+        return None
+    best, best_t = None, float("inf")
+    t_start = time.perf_counter()
+    for cand in candidates:
+        if budget_s is not None and time.perf_counter() - t_start > budget_s:
+            break
+        try:
+            t = time_candidate(cand)
+        except Exception as e:  # disqualified: compile error / OOM
+            if verbose:
+                sys.stderr.write(f"autotune[{op_name}] {cand}: failed ({e})\n")
+            continue
+        if verbose:
+            sys.stderr.write(f"autotune[{op_name}] {cand}: {t * 1e3:.3f} ms\n")
+        if t < best_t:
+            best, best_t = cand, t
+    if best is not None:
+        record(op_name, key, best)
+    return best

@@ -37,11 +37,20 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["causal_attention", "flash_attention_available",
-           "mosaic_block_legal", "flash_block_specs"]
+           "mosaic_block_legal", "flash_block_specs",
+           "tune_causal_attention"]
 
 _BQ = 256
 _BK = 256
 _LANES = 128  # TPU lane width; row stats are replicated across it
+
+# (bq, bk) candidates the autotuner may select from (paddle's
+# phi/kernels/autotune exhaustive search analog, over Mosaic-legal block
+# shapes). All are multiples of 8x128 so every derived BlockSpec stays
+# legal; candidates not dividing S are filtered per shape.
+_BLOCK_CANDIDATES = ((256, 256), (512, 512), (512, 256), (256, 512),
+                     (128, 256), (256, 128), (1024, 512), (512, 1024),
+                     (128, 128), (1024, 1024))
 
 # Flip to True to force the Pallas path through the interpreter (CPU tests).
 _INTERPRET = False
@@ -59,11 +68,12 @@ def _on_tpu():
         return False
 
 
-def flash_attention_available(q_shape):
+def flash_attention_available(q_shape, dtype=None):
     if _DISABLE:
         return False
     B, S, H, D = q_shape
-    shapes_ok = D % 128 == 0 and S % _BQ == 0 and S % _BK == 0 and S >= _BQ
+    bq, bk = _block_config(S, D, dtype)
+    shapes_ok = D % 128 == 0 and S % bq == 0 and S % bk == 0 and S >= bq
     return shapes_ok and (_on_tpu() or _INTERPRET)
 
 
@@ -86,14 +96,45 @@ def mosaic_block_legal(block_shape, array_shape, dtype_bits=32):
     return bs[0] == ashape[0] or bs[0] % tiling == 0
 
 
-def flash_block_specs(BH, S, D):
+def _blocks_legal(bq, bk, S, D):
+    """A cached/tuned (bq, bk) is usable iff it tiles S and every derived
+    HBM BlockSpec is Mosaic-legal, plus the kernel-internal constraint
+    that bk feeds _rep_lanes (bk % 128). Guards against hand-edited or
+    stale persisted autotune caches breaking compilation."""
+    if S % bq or S % bk or S < bq or bk % _LANES:
+        return False
+    specs = flash_block_specs(8, S, D, bq, bk)
+    return all(mosaic_block_legal(blk, arr)
+               for groups in specs.values()
+               for io in ("in", "out")
+               for blk, arr in groups[io])
+
+
+def _block_config(S, D, dtype=None):
+    """Active (bq, bk) for a given sequence/head-dim/dtype: the autotuned
+    winner if one is cached (see tune_causal_attention), else the 256x256
+    default. Read at trace time, so jitted graphs bake in the choice."""
+    from paddle_tpu.ops import autotune
+    cfg = None
+    if dtype is not None:
+        cfg = autotune.lookup(
+            "flash_attention",
+            ["blocks", int(S), int(D), str(jnp.dtype(dtype))])
+    if cfg is None:  # any-dtype fallback entry (pre-dtype caches)
+        cfg = autotune.lookup("flash_attention", ["blocks", int(S), int(D)])
+    if cfg is not None and _blocks_legal(int(cfg[0]), int(cfg[1]), S, D):
+        return int(cfg[0]), int(cfg[1])
+    return _BQ, _BK
+
+
+def flash_block_specs(BH, S, D, bq=_BQ, bk=_BK):
     """(block_shape, array_shape) for every HBM operand of the three flash
     kernels — the single source the pallas_calls below and the shape unit
     test both consume."""
-    qblk = ((1, _BQ, D), (BH, S, D))
-    kblk = ((1, _BK, D), (BH, S, D))
+    qblk = ((1, bq, D), (BH, S, D))
+    kblk = ((1, bk, D), (BH, S, D))
     full = ((1, S, D), (BH, S, D))
-    lse_blk = ((1, _BQ, _LANES), (BH, S, _LANES))
+    lse_blk = ((1, bq, _LANES), (BH, S, _LANES))
     lse_full = ((1, S, _LANES), (BH, S, _LANES))
     return {
         "fwd": {"in": [qblk, full, full], "out": [qblk, lse_blk]},
@@ -167,18 +208,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale):
     lse_ref[0] = m + jnp.log(l)                                # [bq, 128]
 
 
-def _flash_fwd(q, k, v):
+def _flash_fwd(q, k, v, bq=None, bk=None):
     """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S,128] fp32, value
     replicated across the trailing lane dim)."""
     from jax.experimental import pallas as pl
     BH, S, D = q.shape
+    if bq is None or bk is None:
+        bq, bk = _block_config(S, D, q.dtype)
     scale = 1.0 / math.sqrt(D)
-    specs = flash_block_specs(BH, S, D)["fwd"]
-    grid = (BH, S // _BQ)
+    specs = flash_block_specs(BH, S, D, bq, bk)["fwd"]
+    grid = (BH, S // bq)
     blocked = lambda b, i: (b, i, 0)  # noqa: E731
     whole = lambda b, i: (b, 0, 0)    # noqa: E731
     out, lse = pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, bq=_BQ, bk=_BK, scale=scale),
+        functools.partial(_flash_fwd_kernel, bq=bq, bk=bk, scale=scale),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), q.dtype),
                    jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32)),
         grid=grid,
@@ -273,21 +316,23 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, g, o, lse):
+def _flash_bwd(q, k, v, g, o, lse, bq=None, bk=None):
     """q,k,v,g,o: [BH, S, D]; lse: [BH, S, 128]; returns dq, dk, dv."""
     from jax.experimental import pallas as pl
     BH, S, D = q.shape
+    if bq is None or bk is None:
+        bq, bk = _block_config(S, D, q.dtype)
     scale = 1.0 / math.sqrt(D)
-    specs = flash_block_specs(BH, S, D)
+    specs = flash_block_specs(BH, S, D, bq, bk)
 
     blocked = lambda b, i: (b, i, 0)  # noqa: E731
     whole = lambda b, i: (b, 0, 0)    # noqa: E731
 
     dq_specs = specs["bwd_dq"]
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, bq=_BQ, bk=_BK, scale=scale),
+        functools.partial(_flash_bwd_dq_kernel, bq=bq, bk=bk, scale=scale),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        grid=(BH, S // _BQ),
+        grid=(BH, S // bq),
         in_specs=[
             pl.BlockSpec(dq_specs["in"][0][0], blocked),   # q
             pl.BlockSpec(dq_specs["in"][1][0], whole),     # k
@@ -302,11 +347,11 @@ def _flash_bwd(q, k, v, g, o, lse):
 
     dkv_specs = specs["bwd_dkv"]
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, bq=_BQ, bk=_BK, scale=scale,
-                          n_qblocks=S // _BQ),
+        functools.partial(_flash_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale,
+                          n_qblocks=S // bq),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), k.dtype),
                    jax.ShapeDtypeStruct((BH, S, D), v.dtype)),
-        grid=(BH, S // _BK),
+        grid=(BH, S // bk),
         in_specs=[
             pl.BlockSpec(dkv_specs["in"][0][0], whole),    # q
             pl.BlockSpec(dkv_specs["in"][1][0], blocked),  # k
@@ -340,14 +385,14 @@ def _from_bh(x, B, H):
 def causal_attention(q, k, v):
     """Causal self-attention, [B, S, H, D] layout. Pallas flash kernel on
     TPU for qualifying shapes; XLA-fused jnp otherwise."""
-    if flash_attention_available(q.shape):
+    if flash_attention_available(q.shape, q.dtype):
         out, _ = _flash_fwd(_to_bh(q), _to_bh(k), _to_bh(v))
         return _from_bh(out, q.shape[0], q.shape[2])
     return _attention_jnp(q, k, v)
 
 
 def _fwd(q, k, v):
-    if flash_attention_available(q.shape):
+    if flash_attention_available(q.shape, q.dtype):
         B, H = q.shape[0], q.shape[2]
         qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
         out, lse = _flash_fwd(qb, kb, vb)
@@ -369,3 +414,69 @@ def _bwd(res, g):
 
 
 causal_attention.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# autotuning (phi/kernels/autotune analog for the flash kernels)
+# ---------------------------------------------------------------------------
+
+def tune_causal_attention(B, S, H, D, dtype=jnp.bfloat16, budget_s=None,
+                          iters=10, verbose=False):
+    """Measure every legal (bq, bk) candidate for this attention shape on
+    the current device and cache the fastest; subsequent traces of
+    causal_attention at this (S, D, dtype) use the winner.
+
+    Times forward + backward together (one fwd pallas_call + the two
+    backward kernels), matching how training weights the kernels; ``iters``
+    is the number of chained rounds per measurement. Runs eagerly — call
+    before jit-compiling the train step. Returns the chosen (bq, bk), or
+    None when tuning is disabled/disqualified everywhere.
+    """
+    from paddle_tpu.ops import autotune
+
+    dtype = jnp.dtype(dtype)
+    key = ["blocks", int(S), int(D), str(dtype)]
+    cached = autotune.lookup("flash_attention", key)
+    if cached is not None:
+        return tuple(cached)
+    if not (_on_tpu() or _INTERPRET):
+        return None
+
+    BH = B * H
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q, k, v, g = (jax.random.normal(kk, (BH, S, D), dtype) * 0.5 for kk in ks)
+    n_chain = max(1, int(iters))
+
+    def time_candidate(cand):
+        bq, bk = cand
+        if S % bq or S % bk or S < bq:
+            raise ValueError(f"({bq},{bk}) does not tile S={S}")
+
+        # Chain n_chain fwd+bwd rounds inside one executable with a data
+        # dependence between rounds, and read back ONE scalar: device
+        # compute is what gets timed, not the 32MB/call host transfer a
+        # naive per-call measurement pays over the PJRT tunnel.
+        @jax.jit
+        def chained(q, k, v, g):
+            def body(qc, _):
+                out, lse = _flash_fwd(qc, k, v, bq, bk)
+                dq, _dk, _dv = _flash_bwd(qc, k, v, g, out, lse, bq, bk)
+                return qc + dq * jnp.asarray(1e-6, qc.dtype), None
+            qf, _ = lax.scan(body, q, None, length=n_chain)
+            return jnp.sum(qf[0, 0])
+
+        # min over several reps: host-side readback jitter (the PJRT
+        # tunnel adds tens of ms of noise) only ever inflates a
+        # measurement, so the minimum is the least-noisy estimator.
+        import numpy as np
+        import time as _time
+        float(np.asarray(chained(q, k, v, g)))  # compile + warmup
+        reps = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            float(np.asarray(chained(q, k, v, g)))
+            reps.append(_time.perf_counter() - t0)
+        return min(reps) / n_chain
+
+    return autotune.tune("flash_attention", key, _BLOCK_CANDIDATES,
+                         time_candidate, budget_s=budget_s, verbose=verbose)

@@ -1,0 +1,143 @@
+"""Autotune subsystem: cache behavior, config switch, persistence,
+candidate selection. Reference analog: paddle/phi/kernels/autotune/
+cache_test.cc + switch_autotune semantics."""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import autotune, pallas_ops
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    saved_cache = dict(autotune._CACHE)
+    saved_enabled = autotune._ENABLED
+    autotune._CACHE.clear()
+    yield
+    autotune._CACHE.clear()
+    autotune._CACHE.update(saved_cache)
+    autotune._ENABLED = saved_enabled
+
+
+def test_tune_picks_fastest_and_caches():
+    times = {"a": 3.0, "b": 1.0, "c": 2.0}
+    calls = []
+
+    def timer(cand):
+        calls.append(cand)
+        return times[cand]
+
+    best = autotune.tune("op", ["k1"], ["a", "b", "c"], timer)
+    assert best == "b"
+    assert autotune.lookup("op", ["k1"]) == "b"
+    # second tune short-circuits on the cache: no new measurements
+    n = len(calls)
+    assert autotune.tune("op", ["k1"], ["a", "b", "c"], timer) == "b"
+    assert len(calls) == n
+
+
+def test_tune_skips_disqualified_candidates():
+    def timer(cand):
+        if cand == "bad":
+            raise RuntimeError("compile failed")
+        return {"x": 2.0, "y": 1.0}[cand]
+
+    assert autotune.tune("op", ["k"], ["bad", "x", "y"], timer) == "y"
+
+
+def test_tune_all_disqualified_records_nothing():
+    def timer(cand):
+        raise RuntimeError("no")
+
+    assert autotune.tune("op", ["k"], ["a"], timer) is None
+    assert autotune.lookup("op", ["k"]) is None
+
+
+def test_set_config_disables(tmp_path):
+    autotune.set_config({"kernel": {"enable": False}})
+    assert not autotune.enabled()
+    assert autotune.tune("op", ["k"], ["a"], lambda c: 1.0) is None
+    # JSON-file form, as the reference accepts
+    p = tmp_path / "conf.json"
+    p.write_text(json.dumps({"kernel": {"enable": True,
+                                        "tuning_range": [1, 10]}}))
+    autotune.set_config(str(p))
+    assert autotune.enabled()
+
+
+def test_cache_persistence_roundtrip(tmp_path):
+    autotune.record("flash_attention", ["blocks", 2048, 128], (512, 256))
+    path = str(tmp_path / "cache.json")
+    autotune.save(path)
+    autotune._CACHE.clear()
+    autotune.load(path)
+    assert autotune.lookup("flash_attention",
+                           ["blocks", 2048, 128]) == (512, 256)
+
+
+def test_block_config_consumes_tuned_entry():
+    assert pallas_ops._block_config(2048, 128) == (256, 256)  # default
+    autotune.record("flash_attention", ["blocks", 2048, 128], (512, 512))
+    assert pallas_ops._block_config(2048, 128) == (512, 512)
+    # dtype-keyed entry wins over the any-dtype fallback
+    autotune.record("flash_attention",
+                    ["blocks", 2048, 128, "bfloat16"], (1024, 1024))
+    assert pallas_ops._block_config(2048, 128, jnp.bfloat16) == (1024, 1024)
+    assert pallas_ops._block_config(2048, 128, jnp.float32) == (512, 512)
+    # tuned config that does not tile S falls back to the default (512
+    # does not divide 384, and 512x512 != the default, so a broken guard
+    # would be caught here)
+    autotune.record("flash_attention", ["blocks", 384, 128], (512, 512))
+    assert pallas_ops._block_config(384, 128) == (256, 256)
+    # Mosaic-illegal blocks in a (hand-edited) persisted cache are ignored
+    autotune.record("flash_attention", ["blocks", 2304, 128], (192, 192))
+    assert pallas_ops._block_config(2304, 128) == (256, 256)
+
+
+def test_candidate_block_specs_mosaic_legal():
+    """Every autotune candidate yields Mosaic-legal BlockSpecs for every
+    shape it can be selected for (the r02 failure class, across the whole
+    search space)."""
+    for bq, bk in pallas_ops._BLOCK_CANDIDATES:
+        for S in (2048, 4096):
+            if S % bq or S % bk:
+                continue
+            specs = pallas_ops.flash_block_specs(64, S, 128, bq, bk)
+            for kernel, groups in specs.items():
+                for io in ("in", "out"):
+                    for blk, arr in groups[io]:
+                        assert pallas_ops.mosaic_block_legal(blk, arr), (
+                            f"bq={bq} bk={bk} {kernel}/{io}: {blk} vs {arr}")
+
+
+@pytest.mark.slow
+def test_flash_nondefault_blocks_numerics():
+    """Interpreter-mode numerical parity at a non-square tuned config
+    (bq != bk exercises the generalized grid/loop arithmetic)."""
+    import jax
+
+    old = pallas_ops._INTERPRET
+    pallas_ops._INTERPRET = True
+    try:
+        ks = jax.random.split(jax.random.PRNGKey(7), 3)
+        q, k, v = (jax.random.normal(kk, (1, 512, 2, 128), jnp.float32) * 0.5
+                   for kk in ks)
+        autotune.record("flash_attention", ["blocks", 512, 128], (128, 256))
+        out = pallas_ops.causal_attention(q, k, v)
+        ref = pallas_ops._attention_jnp(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g = jax.grad(lambda a, b, c: jnp.sum(
+            pallas_ops.causal_attention(a, b, c) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: jnp.sum(
+            pallas_ops._attention_jnp(a, b, c) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for gf, grr, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(grr),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} mismatch")
+    finally:
+        pallas_ops._INTERPRET = old

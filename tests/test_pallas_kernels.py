@@ -106,11 +106,19 @@ def test_mosaic_lowering_hardware_free():
     q = jnp.zeros((BH, S, D), jnp.bfloat16)
     lse = jnp.zeros((BH, S, 128), jnp.float32)
     # fixture sets _INTERPRET=True; lowering must see the real kernels
+    import functools
     pallas_ops._INTERPRET = False
     try:
         jax.export.export(jax.jit(pallas_ops._flash_fwd),
                           platforms=["tpu"])(q, q, q)
         jax.export.export(jax.jit(pallas_ops._flash_bwd),
                           platforms=["tpu"])(q, q, q, q, q, lse)
+        # a non-square autotune candidate lowers too
+        jax.export.export(
+            jax.jit(functools.partial(pallas_ops._flash_fwd, bq=512, bk=256)),
+            platforms=["tpu"])(q, q, q)
+        jax.export.export(
+            jax.jit(functools.partial(pallas_ops._flash_bwd, bq=512, bk=256)),
+            platforms=["tpu"])(q, q, q, q, q, lse)
     finally:
         pallas_ops._INTERPRET = True
