@@ -10,17 +10,23 @@ the predictor simply binds inputs, runs the compiled executable, and
 returns host arrays. Mixed precision / device placement are jit-time
 properties of the exported function.
 
-C++ serving host (scope note): the StableHLO artifact is the stable,
-language-neutral boundary — a C++ loader would drive it through the PJRT
+Native serving host: csrc/predictor_capi.cc builds libpaddle_tpu_capi.so,
+the C ABI a non-Python serving process links against (reference:
+paddle/fluid/inference/capi_exp/pd_inference_api.h) — PD_PredictorCreate
+on a jit.save prefix, PD_PredictorRun on raw buffers; the embedded
+runtime executes the AOT-exported StableHLO module. End-to-end compiled
+test: tests/test_capi_predictor.py.
+
+PJRT-direct loader (scope note): a host that bypasses the embedded
+runtime entirely would drive the same .stablehlo files through the PJRT
 C API (PJRT_Client_Compile + PJRT_LoadedExecutable_Execute against
-libtpu's GetPjrtApi). That loader is NOT buildable in this tree today:
+libtpu's GetPjrtApi). That variant is NOT buildable in this tree today:
 the installed jaxlib links its PJRT clients statically into the python
 extension and ships neither the pjrt_c_api.h header nor a standalone
-plugin .so to link against. When a libtpu/PJRT SDK is present, the
-loader is a thin consumer of the exact .stablehlo files jit.save already
-produces — no framework changes needed. ONNX export is likewise gated:
-no onnx runtime in this environment; the StableHLO artifact is the
-supported interchange format.
+plugin .so to link against; with a libtpu/PJRT SDK present it is a thin
+consumer of the same artifacts behind the same C header. ONNX export is
+likewise gated: no onnx runtime in this environment; the StableHLO
+artifact is the supported interchange format.
 """
 from __future__ import annotations
 
