@@ -1,12 +1,24 @@
-"""Failure detection: heartbeats + staleness monitor.
+"""Elastic training: heartbeats, staleness monitor, relaunch protocol,
+scale up/down.
 
 Reference analog: python/paddle/distributed/fleet/elastic/manager.py:126
 (ElasticManager — etcd-registered node heartbeats, a watchdog that
-declares nodes dead and triggers pod restart). Scale-in/scale-out
-membership changes are out of scope for now; what this provides is the
-failure-detection half: process EXITS are caught by the launcher's
-poll-based watchdog, and in-process HANGS are caught here through
-heartbeat staleness.
+declares nodes dead and triggers pod restart, scale up/down by watching
+membership, and the exit-code relaunch protocol: a worker exiting with
+code 101 asks to be relaunched rather than counted as failed). Three
+halves here:
+
+- failure detection: process EXITS are caught by the launcher's
+  poll-based watchdog, in-process HANGS by heartbeat staleness
+  (``start_heartbeat`` / ``HeartbeatMonitor``);
+- cooperative relaunch: ``ElasticJob`` honors RELAUNCH_EXIT_CODE without
+  consuming the restart budget (manager.py's exit-code-101 contract);
+- scale events: the world size is a watched key in the job's TCPStore
+  (``request_scale`` writes it — the etcd-watch analog); on change the
+  gang is torn down and respawned at the new size, clamped to
+  [min_nproc, max_nproc], with PADDLE_TRAINERS_NUM re-rendered. Workers
+  resume from their latest checkpoint (distributed.checkpoint restores
+  across mesh shapes, so a different world size is a supported resume).
 
 TPU-native shape: heartbeats ride the same native TCPStore the launcher
 already serves for rendezvous (csrc/tcp_store.cc) — no etcd. Each beat
@@ -19,11 +31,19 @@ so scripts that don't cooperate simply keep exit-code-only supervision.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["start_heartbeat", "HeartbeatMonitor"]
+__all__ = ["start_heartbeat", "HeartbeatMonitor", "ElasticJob",
+           "request_scale", "RELAUNCH_EXIT_CODE"]
+
+# Worker exit code meaning "relaunch me" (checkpoint saved, membership
+# changed, re-plan wanted...). Reference: manager.py's exit-code-101
+# protocol (ELASTIC_AUTO_PARALLEL_EXIT_CODE plays the same role for
+# re-planning). Does not consume the restart budget.
+RELAUNCH_EXIT_CODE = 101
 
 
 def _hb_key(job_id: str, restart: str, rank: str) -> str:
@@ -74,7 +94,7 @@ class HeartbeatMonitor:
     def __init__(self, store, job_id: str, nproc: int, timeout: float):
         self._store = store
         self._job_id = job_id
-        self._nproc = nproc
+        self.nproc = nproc  # public: elastic rescales adjust it
         self._timeout = timeout
         # rank -> (last counter value, monitor time it last changed)
         self._seen: Dict[int, tuple] = {}
@@ -88,7 +108,7 @@ class HeartbeatMonitor:
         # healthy rank hung
         now = time.monotonic() if now is None else now
         stale = []
-        for rank in range(self._nproc):
+        for rank in range(self.nproc):
             key = _hb_key(self._job_id, str(restart_count), str(rank))
             raw = self._store.get(key)
             if raw is None:
@@ -103,3 +123,113 @@ class HeartbeatMonitor:
             elif now - prev[1] > self._timeout:
                 stale.append(rank)
         return stale
+
+
+def _scale_key(job_id: str) -> str:
+    return f"elastic/{job_id}/world_size"
+
+
+def request_scale(master: str, job_id: str, nproc: int, store=None):
+    """Operator side: ask a running ElasticJob to change its world size
+    (the etcd-watch analog — any party with store access can scale the
+    job). ``master`` is the job's ``host:port`` rendezvous address."""
+    if store is None:
+        from ..store import TCPStore
+        host, port = master.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=False, timeout=60)
+        if not store.is_native:
+            # the fallback store is process-local: set() would write into
+            # THIS process's dict and the job would never see the key
+            raise RuntimeError(
+                "request_scale needs the native TCPStore client to reach "
+                f"the job at {master} (build csrc/: make -C csrc); the "
+                "in-process fallback cannot deliver scale requests")
+    store.set(_scale_key(job_id), str(int(nproc)).encode())
+
+
+from ..launch import LocalJob  # noqa: E402  (no import cycle: launch only
+# imports fleet.elastic lazily inside functions)
+
+
+class ElasticJob(LocalJob):
+    """Elastic pod supervisor (ElasticManager analog, a LocalJob
+    subclass overriding the _check_rescale extension point + run loop).
+
+    Differences from a fixed LocalJob pod:
+    - world size follows the store's scale key, clamped to
+      [min_nproc, max_nproc]; a change tears the gang down and respawns
+      at the new size without consuming the restart budget;
+    - a worker exiting RELAUNCH_EXIT_CODE triggers a free gang relaunch;
+    - every (re)launch increments PADDLE_RESTART_COUNT so heartbeat keys
+      and rendezvous epochs never collide across generations.
+    """
+
+    def __init__(self, script, script_args, nproc, min_nproc=1,
+                 max_nproc=None, **job_kwargs):
+        super().__init__(script, script_args, int(nproc), **job_kwargs)
+        self.min_nproc = max(1, int(min_nproc))
+        self.max_nproc = int(max_nproc) if max_nproc else int(nproc)
+        self._last_scale_raw = None
+        self._failures = 0  # real failures only; free relaunches excluded
+
+    # -- scale watching -----------------------------------------------------
+    def _read_scale(self):
+        """ONE store read -> (raw, want). All scale decisions in a cycle
+        derive from the same raw value, so a request landing between two
+        reads can never be half-seen and dropped."""
+        raw = self._store.get(_scale_key(self.job_id))
+        if raw is None:
+            return None, None
+        try:
+            want = max(self.min_nproc, min(self.max_nproc, int(raw)))
+        except ValueError:
+            return raw, None
+        return raw, want
+
+    def _check_rescale(self) -> bool:
+        raw, want = self._read_scale()
+        if raw is None or raw == self._last_scale_raw:
+            return False
+        return want is not None and want != self.nproc
+
+    # -- supervision --------------------------------------------------------
+    def run(self, poll_interval: float = 0.2) -> int:
+        if self._store is None:
+            self._start_store()
+        while True:
+            raw, want = self._read_scale()
+            self._last_scale_raw = raw
+            if want is not None and want != self.nproc:
+                self.nproc = want
+                if self._monitor is not None:
+                    self._monitor.nproc = want
+            workers = [self._spawn_one(r) for r in range(self.nproc)]
+            rc = self._watch(workers, poll_interval)
+            if rc == 0:
+                return 0
+            # every respawn is a new generation: PADDLE_RESTART_COUNT (and
+            # with it the heartbeat/rendezvous epoch) must never repeat
+            self.restart_count += 1
+            if rc == self.RESCALE_RC:
+                sys.stderr.write(
+                    "elastic: scale event; respawning gang at the new "
+                    "world size\n")
+                continue
+            if rc == RELAUNCH_EXIT_CODE:
+                sys.stderr.write(
+                    "elastic: worker requested relaunch (exit 101); "
+                    "respawning gang\n")
+                continue
+            self._failures += 1
+            if self._failures > self.max_restarts:
+                sys.stderr.write(
+                    f"elastic: pod failed rc={rc} after exhausting "
+                    f"{self.max_restarts} restarts; giving up\n")
+                return rc
+            sys.stderr.write(
+                f"elastic: worker failure rc={rc}; gang restart "
+                f"{self._failures}/{self.max_restarts}\n")
+
+    @property
+    def master(self) -> str:
+        return f"{self.master_host}:{self.master_port}"

@@ -17,9 +17,12 @@ contract init_parallel_env consumes. Worker stdout/stderr stream to
 the reference pod watchdog: one dead rank kills the pod, and the pod
 restarts as a unit up to ``--max_restarts`` times.
 
-Full elastic (membership changes at runtime, fault-tolerant etcd
-rendezvous) is intentionally deferred; the restart loop covers the
-fail-fast half of the reference's elastic manager.
+Elastic mode (``--elastic``) supervises the pod with
+fleet.elastic.ElasticJob: world-size scale events watched on the job's
+TCPStore (``--scale`` operator CLI / ``request_scale``), the exit-101
+cooperative relaunch protocol, and bounds via ``--min_nproc`` /
+``--max_nproc`` — the reference ElasticManager's contract with the
+TCPStore standing in for etcd.
 """
 from __future__ import annotations
 
@@ -43,6 +46,10 @@ class _Worker:
 
 class LocalJob:
     """A pod of nproc workers on this host with gang restart."""
+
+    # sentinel _watch returns when a scale event interrupts the gang
+    # (only ElasticJob's _check_rescale can trigger it)
+    RESCALE_RC = -1001
 
     def __init__(self, script: str, script_args: List[str], nproc: int,
                  master: Optional[str] = None, log_dir: str = "log",
@@ -156,6 +163,9 @@ class LocalJob:
                         return rc
                 if not alive:
                     return 0
+                if self._check_rescale():
+                    self._kill_all(workers)
+                    return self.RESCALE_RC
                 if self._monitor is not None:
                     stale = self._monitor.stale_ranks(self.restart_count)
                     stale = [r for r in stale
@@ -171,6 +181,9 @@ class LocalJob:
         except KeyboardInterrupt:
             self._kill_all(workers)
             raise
+
+    def _check_rescale(self) -> bool:
+        return False  # fixed-size pods never rescale
 
     def close(self):
         if self._store is not None:
@@ -197,15 +210,48 @@ def main(argv=None) -> int:
                              "this many seconds; hung pods gang-restart")
     parser.add_argument("--module", action="store_true",
                         help="run script as a python module (-m)")
-    parser.add_argument("script")
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise with the elastic manager: scale "
+                             "events via the job store, exit-101 relaunch "
+                             "protocol (fleet.elastic.ElasticJob)")
+    parser.add_argument("--min_nproc", type=int, default=1,
+                        help="elastic: lower world-size bound")
+    parser.add_argument("--max_nproc", type=int, default=None,
+                        help="elastic: upper world-size bound "
+                             "(default: --nproc_per_node)")
+    parser.add_argument("--scale", type=int, default=None, metavar="N",
+                        help="operator mode: ask the running job at "
+                             "--master/--job_id to rescale to N workers, "
+                             "then exit (no script needed)")
+    parser.add_argument("script", nargs="?")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
-    job = LocalJob(args.script, args.script_args, args.nproc_per_node,
-                   master=args.master, log_dir=args.log_dir,
-                   job_id=args.job_id, max_restarts=args.max_restarts,
-                   use_module=args.module,
-                   heartbeat_timeout=args.heartbeat_timeout)
+    if args.scale is not None:
+        if not args.master:
+            parser.error("--scale requires --master host:port")
+        from ..fleet.elastic import request_scale
+        request_scale(args.master, args.job_id, args.scale)
+        return 0
+    if not args.script:
+        parser.error("script is required (unless using --scale)")
+
+    if args.elastic:
+        from ..fleet.elastic import ElasticJob
+        job = ElasticJob(args.script, args.script_args,
+                         args.nproc_per_node, min_nproc=args.min_nproc,
+                         max_nproc=args.max_nproc,
+                         master=args.master, log_dir=args.log_dir,
+                         job_id=args.job_id,
+                         max_restarts=args.max_restarts,
+                         use_module=args.module,
+                         heartbeat_timeout=args.heartbeat_timeout)
+    else:
+        job = LocalJob(args.script, args.script_args, args.nproc_per_node,
+                       master=args.master, log_dir=args.log_dir,
+                       job_id=args.job_id, max_restarts=args.max_restarts,
+                       use_module=args.module,
+                       heartbeat_timeout=args.heartbeat_timeout)
     try:
         return job.run()
     finally:

@@ -113,3 +113,81 @@ def test_launch_detects_hung_worker_via_heartbeat(tmp_path):
     assert "gang restart 1/2" in proc.stderr
     log1 = (log_dir / "workerlog.1").read_text()
     assert "done rank=1 restart=1" in log1
+
+
+_SCRIPT_RELAUNCH = """
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+print(f"start rank={rank} restart={restart}", flush=True)
+if restart == 0 and rank == 0:
+    os._exit(101)  # cooperative relaunch request (checkpointed, re-plan...)
+print(f"done rank={rank} restart={restart}", flush=True)
+"""
+
+_SCRIPT_SCALE = """
+import os, time
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+n = os.environ["PADDLE_TRAINERS_NUM"]
+print(f"gen={restart} rank={rank} n={n}", flush=True)
+if restart == 0:
+    if rank == 0:
+        # scale-in request from inside the job (any store client works)
+        from paddle_tpu.distributed.fleet.elastic import request_scale
+        request_scale(os.environ["PADDLE_MASTER"],
+                      os.environ["PADDLE_JOB_ID"], 2)
+    time.sleep(120)  # wait for the manager to tear this generation down
+print(f"done gen={restart} rank={rank} n={n}", flush=True)
+"""
+
+
+def _run_elastic(tmp_path, script_body, nproc=3, max_restarts=0,
+                 extra=()):
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(script_body))
+    log_dir = tmp_path / "log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--elastic", "--nproc_per_node", str(nproc),
+         "--log_dir", str(log_dir), "--max_restarts", str(max_restarts),
+         *extra, str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    return proc, log_dir
+
+
+def test_elastic_relaunch_protocol_is_budget_free(tmp_path):
+    # max_restarts=0: a normal failure would give up immediately, so a
+    # passing run proves exit-101 did not consume the budget
+    proc, log_dir = _run_elastic(tmp_path, _SCRIPT_RELAUNCH,
+                                 nproc=2, max_restarts=0)
+    assert proc.returncode == 0, (proc.stderr[-2000:],)
+    assert "requested relaunch" in proc.stderr
+    log0 = (log_dir / "workerlog.0").read_text()
+    assert "start rank=0 restart=0" in log0
+    assert "done rank=0 restart=1" in log0
+
+
+def test_elastic_scale_in_respawns_smaller_gang(tmp_path):
+    proc, log_dir = _run_elastic(tmp_path, _SCRIPT_SCALE,
+                                 nproc=3, max_restarts=0,
+                                 extra=("--min_nproc", "1"))
+    assert proc.returncode == 0, (proc.stderr[-2000:],)
+    assert "scale event" in proc.stderr
+    # generation 0 ran 3 ranks; generation 1 ran 2
+    log0 = (log_dir / "workerlog.0").read_text()
+    assert "gen=0 rank=0 n=3" in log0
+    assert "done gen=1 rank=0 n=2" in log0
+    log1 = (log_dir / "workerlog.1").read_text()
+    assert "done gen=1 rank=1 n=2" in log1
+    # rank 2 must NOT have a generation-1 entry
+    log2 = (log_dir / "workerlog.2").read_text()
+    assert "gen=1" not in log2
+
+
+def test_scale_cli_requires_master():
+    from paddle_tpu.distributed.launch import main
+    with pytest.raises(SystemExit):
+        main(["--scale", "4"])
