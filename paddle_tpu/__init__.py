@@ -57,6 +57,7 @@ from . import device  # noqa: E402
 from . import static  # noqa: E402
 from . import distribution  # noqa: E402
 from . import geometric  # noqa: E402
+from . import onnx  # noqa: E402
 from . import utils  # noqa: E402
 from . import quantization  # noqa: E402
 from . import text  # noqa: E402

@@ -190,7 +190,16 @@ class ElasticJob(LocalJob):
         raw, want = self._read_scale()
         if raw is None or raw == self._last_scale_raw:
             return False
-        return want is not None and want != self.nproc
+        if want is None or want == self.nproc:
+            # unparseable, or clamped to the current size: tell the
+            # operator once instead of silently swallowing the request
+            sys.stderr.write(
+                f"elastic: scale request {raw!r} resolves to the current "
+                f"world size {self.nproc} (bounds [{self.min_nproc}, "
+                f"{self.max_nproc}]); ignoring\n")
+            self._last_scale_raw = raw
+            return False
+        return True
 
     # -- supervision --------------------------------------------------------
     def run(self, poll_interval: float = 0.2) -> int:

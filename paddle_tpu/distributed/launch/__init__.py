@@ -178,7 +178,9 @@ class LocalJob:
                         self._kill_all(workers)
                         return 1
                 time.sleep(poll_interval)
-        except KeyboardInterrupt:
+        except BaseException:
+            # ctrl-C, store errors from the rescale poll, anything: the
+            # gang must never be orphaned behind a dead supervisor
             self._kill_all(workers)
             raise
 

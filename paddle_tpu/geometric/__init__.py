@@ -14,7 +14,7 @@ from ..ops.registry import register, _ensure_tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min",
-           "sample_neighbors", "reindex_graph"]
+           "sample_neighbors", "reindex_graph", "reindex_heter_graph"]
 
 
 def _segment(name, combiner):
@@ -183,4 +183,40 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     reindex_dst = np.repeat(np.arange(len(xa), dtype=np.int64), ca)
     return (Tensor(jnp.asarray(reindex_src)),
             Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Relabel center nodes + per-edge-type neighbor lists with ONE shared
+    id space: centers first, then neighbors in first-seen order across the
+    edge types in list order; edges of every type are concatenated
+    (reference: python/paddle/geometric/reindex.py reindex_heter_graph)."""
+    import numpy as np
+    if len(neighbors) != len(count):
+        raise ValueError(
+            f"neighbors and count must pair per edge type: got "
+            f"{len(neighbors)} neighbor lists vs {len(count)} count lists")
+    xa = np.asarray(x._array if isinstance(x, Tensor) else x).reshape(-1)
+    nas = [np.asarray(n._array if isinstance(n, Tensor) else n).reshape(-1)
+           for n in neighbors]
+    cas = [np.asarray(c._array if isinstance(c, Tensor) else c).reshape(-1)
+           for c in count]
+    mapping = {}
+    for nd in xa:
+        mapping.setdefault(int(nd), len(mapping))
+    for na in nas:
+        for nd in na:
+            mapping.setdefault(int(nd), len(mapping))
+    out_nodes = np.fromiter(mapping.keys(), dtype=xa.dtype,
+                            count=len(mapping))
+    src_parts, dst_parts = [], []
+    for na, ca in zip(nas, cas):
+        src_parts.append(
+            np.asarray([mapping[int(nd)] for nd in na], np.int64))
+        dst_parts.append(np.repeat(np.arange(len(xa), dtype=np.int64), ca))
+    cat = lambda parts: (np.concatenate(parts) if parts  # noqa: E731
+                         else np.zeros(0, np.int64))
+    return (Tensor(jnp.asarray(cat(src_parts))),
+            Tensor(jnp.asarray(cat(dst_parts))),
             Tensor(jnp.asarray(out_nodes)))

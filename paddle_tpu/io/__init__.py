@@ -16,8 +16,10 @@ from .sampler import (Sampler, SequenceSampler, RandomSampler,
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler, SubsetRandomSampler)
 from .dataloader import DataLoader, default_collate_fn, get_worker_info
+from .device_loader import DeviceDataLoader, stage_to_device
 
-__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+__all__ = ["DeviceDataLoader", "stage_to_device",
+           "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "ConcatDataset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler",
            "WeightedRandomSampler", "BatchSampler",

@@ -117,6 +117,12 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.return_list = return_list
+        # `places` pins output batches to a device; with use_buffer_reader
+        # the transfer double-buffers ahead of the consumer (the pinned
+        # buffered_reader analog — see io/device_loader.py)
+        self.places = places if isinstance(places, (list, tuple, type(None))) \
+            else [places]
+        self.use_buffer_reader = use_buffer_reader
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
@@ -144,10 +150,24 @@ class DataLoader:
 
     def __iter__(self):
         if self._is_iterable:
-            return self._iter_iterable()
-        if self.num_workers == 0:
-            return self._iter_single()
-        return self._iter_multi()
+            it = self._iter_iterable()
+        elif self.num_workers == 0:
+            it = self._iter_single()
+        else:
+            it = self._iter_multi()
+        if self.places:
+            if len(self.places) > 1:
+                raise ValueError(
+                    "multi-place DataLoader output is not supported: one "
+                    "jax client owns all local chips, so in-host data "
+                    "parallelism is expressed by sharding the batch over "
+                    "a mesh (device_put with a distributed.NamedSharding "
+                    "over the 'dp' axis), not by per-place feeding")
+            from .device_loader import DeviceDataLoader
+            buf = self.prefetch_factor if self.use_buffer_reader else 1
+            return iter(DeviceDataLoader(it, self.places[0],
+                                         buffer_size=buf))
+        return it
 
     def _iter_iterable(self):
         batch = []

@@ -1,0 +1,40 @@
+"""paddle.onnx surface (scope-gated).
+
+Reference analog: python/paddle/onnx/export.py — a thin wrapper over the
+external paddle2onnx converter. This environment ships no onnx package or
+runtime, and the TPU serving stack's supported interchange format is the
+StableHLO artifact jit.save produces (loadable by the python Predictor and
+the native C serving ABI — see paddle_tpu/inference). export() therefore
+converts the layer to the supported artifact when asked, and refuses with
+a precise error rather than silently writing a file that is not ONNX.
+"""
+from __future__ import annotations
+
+__all__ = ["export", "is_supported"]
+
+
+def is_supported() -> bool:
+    """True when a real ONNX converter/runtime is importable."""
+    try:
+        import onnx  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference signature (python/paddle/onnx/export.py). Without an
+    onnx package this raises and points at jit.save, the supported
+    artifact; with one present, conversion would ride paddle2onnx's
+    approach (graph export -> onnx opset mapping), which is out of scope
+    in this tree."""
+    if not is_supported():
+        raise NotImplementedError(
+            "ONNX export is out of scope on this stack: no onnx package "
+            "in the environment. The supported interchange artifact is "
+            "StableHLO — use paddle_tpu.jit.save(layer, path, "
+            "input_spec=...) and serve it with paddle_tpu.inference "
+            "(python) or libpaddle_tpu_capi.so (C ABI).")
+    raise NotImplementedError(
+        "onnx package found, but the paddle2onnx-style converter is not "
+        "bundled in this tree; export via jit.save (StableHLO) instead.")

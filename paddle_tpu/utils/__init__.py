@@ -4,9 +4,10 @@ from __future__ import annotations
 import threading
 
 __all__ = ["unique_name", "try_import", "flops", "dlpack", "deprecated",
-           "cpp_extension"]
+           "cpp_extension", "download"]
 
 from . import cpp_extension
+from . import download
 
 
 class _UniqueNameGenerator:

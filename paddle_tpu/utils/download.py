@@ -1,0 +1,117 @@
+"""Model/dataset artifact cache.
+
+Reference analog: python/paddle/utils/download.py — get_weights_path_from_url
+/ get_path_from_url: a content cache under WEIGHTS_HOME keyed by filename,
+md5-validated, with archive decompression. Same contract here; sources may
+be http(s) URLs (fetched with urllib when the environment has egress),
+``file://`` URLs, or plain local paths (copied into the cache — the common
+case for air-gapped TPU pods, where artifacts arrive via GCS fuse mounts
+or rsync rather than the public internet).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url",
+           "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.path.join(
+    os.environ.get("PADDLE_TPU_HOME",
+                   os.path.join(os.path.expanduser("~"), ".cache",
+                                "paddle_tpu")),
+    "weights")
+
+
+def _md5check(path: str, md5sum: str | None) -> bool:
+    if not md5sum:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def _is_archive(path: str) -> bool:
+    return tarfile.is_tarfile(path) or zipfile.is_zipfile(path)
+
+
+def _decompress(path: str) -> str:
+    root = os.path.dirname(path)
+    marker = path + ".extracted"
+    if os.path.exists(marker):  # already extracted (skip the re-I/O and
+        with open(marker) as f:  # the mid-read overwrite hazard)
+            prior = f.read().strip()
+        if prior and os.path.exists(prior):
+            return prior
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as tf:
+            names = tf.getnames()
+            tf.extractall(root, filter="data")
+    else:
+        with zipfile.ZipFile(path) as zf:
+            names = zf.namelist()
+            rootabs = os.path.abspath(root)
+            for n in names:  # the zip analog of tar's filter="data"
+                dest = os.path.abspath(os.path.join(root, n))
+                if not dest.startswith(rootabs + os.sep):
+                    raise RuntimeError(
+                        f"archive entry escapes extraction root: {n!r}")
+            zf.extractall(root)
+    top = {n.split("/", 1)[0] for n in names if n}
+    out = os.path.join(root, top.pop()) if len(top) == 1 else root
+    with open(marker, "w") as f:
+        f.write(out)
+    return out
+
+
+def _fetch(url: str, dst: str):
+    """Copy/download ``url`` to ``dst``. Local paths and file:// copy;
+    http(s) uses urllib (raises a clear error when the host has no
+    egress, pointing at the local-path alternative)."""
+    if url.startswith("file://"):
+        url = url[len("file://"):]
+    if os.path.exists(url):
+        shutil.copy(url, dst)
+        return
+    if url.startswith(("http://", "https://")):
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(dst, "wb") as f:
+                shutil.copyfileobj(r, f)
+            return
+        except Exception as e:
+            raise RuntimeError(
+                f"download of {url} failed ({e}); on air-gapped hosts, "
+                f"place the file locally and pass its path, or pre-seed "
+                f"the cache at {os.path.dirname(dst)}") from e
+    raise FileNotFoundError(f"no such artifact source: {url}")
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
+                      check_exist: bool = True, decompress: bool = True)\
+        -> str:
+    """Fetch-or-reuse ``url`` in the ``root_dir`` cache; returns the local
+    path (the extraction root for archives)."""
+    os.makedirs(root_dir, exist_ok=True)
+    fname = os.path.basename(url.rstrip("/")) or "artifact"
+    fullpath = os.path.join(root_dir, fname)
+    if not (check_exist and os.path.exists(fullpath)
+            and _md5check(fullpath, md5sum)):
+        _fetch(url, fullpath)
+        if not _md5check(fullpath, md5sum):
+            os.remove(fullpath)
+            raise RuntimeError(f"md5 mismatch for {url}")
+    if decompress and os.path.isfile(fullpath) and _is_archive(fullpath):
+        return _decompress(fullpath)
+    return fullpath
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    """Reference signature: cache under WEIGHTS_HOME."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
