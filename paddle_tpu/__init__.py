@@ -55,6 +55,15 @@ from . import inference  # noqa: E402
 from . import hapi  # noqa: E402
 from . import device  # noqa: E402
 from . import static  # noqa: E402
+from .static.program import (enable_static, disable_static)  # noqa: E402
+
+
+def in_dynamic_mode():
+    from .static.program import in_static_mode
+    return not in_static_mode()
+
+
+in_dygraph_mode = in_dynamic_mode
 from . import distribution  # noqa: E402
 from . import geometric  # noqa: E402
 from . import onnx  # noqa: E402

@@ -298,6 +298,11 @@ def _as_array(x):
 # Plays the role of the "AMP Logic" block eager_gen.py emits into every
 # generated ad_func.
 _AMP_CAST_HOOK = [None]
+# static-graph recording (static/program.py): when set, every apply_op
+# also appends (pure_fn, tensor inputs, outputs, op_name) to the active
+# Program — the "LayerHelper.append_op" half of the reference's dual
+# dispatch, with zero overhead when no program is active
+_STATIC_RECORD_HOOK = [None]
 
 
 def apply_op(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1,
@@ -330,9 +335,17 @@ def apply_op(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1,
         if isinstance(out, (tuple, list)):
             outs = [Tensor(o, stop_gradient=True) for o in out]
             _maybe_check_nan_inf(op_name, outs)
+            if _STATIC_RECORD_HOOK[0] is not None:
+                _STATIC_RECORD_HOOK[0](pure_fn,
+                                       [inputs[i] for i in tensor_idx],
+                                       outs, op_name)
             return tuple(outs)
         res = Tensor(out, stop_gradient=True)
         _maybe_check_nan_inf(op_name, (res,))
+        if _STATIC_RECORD_HOOK[0] is not None:
+            _STATIC_RECORD_HOOK[0](pure_fn,
+                                   [inputs[i] for i in tensor_idx],
+                                   [res], op_name)
         return res
 
     out, vjp_fn = jax.vjp(pure_fn, *arrays)
@@ -344,6 +357,9 @@ def apply_op(fn: Callable, *inputs, op_name: str = "", n_outs: int = 1,
     for t in out_tensors:
         t._node = node
     _maybe_check_nan_inf(op_name, out_tensors)
+    if _STATIC_RECORD_HOOK[0] is not None:
+        _STATIC_RECORD_HOOK[0](pure_fn, [inputs[i] for i in tensor_idx],
+                               out_tensors, op_name)
     if multi:
         return tuple(out_tensors)
     return out_tensors[0]

@@ -190,9 +190,15 @@ class ElasticJob(LocalJob):
         raw, want = self._read_scale()
         if raw is None or raw == self._last_scale_raw:
             return False
-        if want is None or want == self.nproc:
-            # unparseable, or clamped to the current size: tell the
-            # operator once instead of silently swallowing the request
+        if want is None:
+            sys.stderr.write(
+                f"elastic: scale request {raw!r} is not an integer; "
+                "ignoring\n")
+            self._last_scale_raw = raw
+            return False
+        if want == self.nproc:
+            # clamped/identical: tell the operator once rather than
+            # silently swallowing the request
             sys.stderr.write(
                 f"elastic: scale request {raw!r} resolves to the current "
                 f"world size {self.nproc} (bounds [{self.min_nproc}, "

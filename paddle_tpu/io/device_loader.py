@@ -46,6 +46,8 @@ def stage_to_device(batch, device=None):
             return jax.device_put(leaf, device)
         return leaf
 
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+        return type(batch)(*(stage_to_device(b, device) for b in batch))
     if isinstance(batch, (list, tuple)):
         return type(batch)(stage_to_device(b, device) for b in batch)
     if isinstance(batch, dict):

@@ -1,11 +1,24 @@
-"""paddle.static parity.
+"""paddle.static: the static-graph user surface.
 
-Reference analog: python/paddle/static/ — Program/Executor/program_guard.
-TPU-native stance (SURVEY.md §7): the static graph IS the jaxpr/HLO trace;
-`Program` wraps a traced function, `Executor.run` invokes the compiled
-XLA executable (the InterpreterCore analog), and save/load_inference_model
-ride jit.save/load's StableHLO artifacts. This module exists for API
-compatibility; new code should use paddle_tpu.jit directly.
+Reference analog: python/paddle/static/ — Program/Executor/program_guard/
+data, built on ProgramDesc + the StandaloneExecutor. Here the build side
+records every op the API applies (see static/program.py for the full
+mapping: op list = ProgramDesc, jit-compiled replay = InterpreterCore,
+per-signature executable cache = _ExecutorCache), so the classic
+workflow works end to end:
+
+    paddle.enable_static()
+    x = static.data("x", [None, 8])
+    y = static.data("y", [None, 1])
+    loss = paddle.mean((static.nn.fc(x, 1) - y) ** 2)
+    paddle.optimizer.SGD(0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    loss_val, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+
+Control flow (static.nn.cond/while_loop/...) lowers to lax.cond /
+lax.while_loop (static/control_flow.py); to_static/jit.save remain the
+preferred path for new code.
 """
 from __future__ import annotations
 
@@ -13,87 +26,20 @@ import contextlib
 
 from ..jit.api import InputSpec, StaticFunction, to_static
 from ..core.tensor import Tensor
+from .program import (Program, Executor, program_guard,
+                      default_main_program, default_startup_program,
+                      enable_static, disable_static, in_static_mode, data)
 
 __all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "Executor", "data", "name_scope",
            "py_func", "save_inference_model", "load_inference_model",
-           "gradients"]
-
-
-class Program:
-    """A deferred-build graph: records a python callable + input specs."""
-
-    def __init__(self):
-        self._fn = None
-        self._input_specs = []
-        self._fetch = []
-
-    def clone(self, for_test=False):
-        p = Program()
-        p._fn = self._fn
-        p._input_specs = list(self._input_specs)
-        return p
-
-    def global_block(self):
-        return self
-
-    # minimal block API for compat
-    def var(self, name):
-        raise KeyError(name)
-
-
-_main_program = Program()
-_startup_program = Program()
-
-
-def default_main_program():
-    return _main_program
-
-
-def default_startup_program():
-    return _startup_program
-
-
-@contextlib.contextmanager
-def program_guard(main_program, startup_program=None):
-    global _main_program, _startup_program
-    prev = (_main_program, _startup_program)
-    _main_program = main_program
-    if startup_program is not None:
-        _startup_program = startup_program
-    try:
-        yield
-    finally:
-        _main_program, _startup_program = prev
+           "gradients", "enable_static", "disable_static",
+           "in_static_mode"]
 
 
 @contextlib.contextmanager
 def name_scope(prefix=None):
     yield
-
-
-def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
-
-
-class Executor:
-    """reference: python/paddle/fluid/executor.py:1387 Executor.run →
-    StandaloneExecutor. Here: calls jit-compiled functions."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True):
-        if callable(program):
-            args = [v for v in (feed or {}).values()]
-            out = program(*args)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            if return_numpy:
-                return [o.numpy() if isinstance(o, Tensor) else o
-                        for o in outs]
-            return list(outs)
-        return []
 
 
 def py_func(func, x, out, backward_func=None):
@@ -102,7 +48,6 @@ def py_func(func, x, out, backward_func=None):
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    from ..jit import save as jsave
     raise NotImplementedError(
         "save_inference_model: use paddle_tpu.jit.save(layer, path, "
         "input_spec=...) — the StableHLO serving path")

@@ -19,7 +19,10 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     for d in x.shape[num_flatten_dims:]:
         in_features *= d
     from ..tensor.manipulation import reshape
-    flat = reshape(x, list(x.shape[:num_flatten_dims]) + [in_features])
+    # -1 for the leading (batch-like) extent: static programs are built
+    # on placeholder batch 1 but replayed at the fed batch size
+    flat = reshape(x, [-1, in_features]) if num_flatten_dims == 1 else \
+        reshape(x, list(x.shape[:num_flatten_dims]) + [in_features])
     layer = Linear(in_features, size, weight_attr, bias_attr)
     out = layer(flat)
     if activation:

@@ -1,0 +1,334 @@
+"""Static graph core: Program recording + Executor replay.
+
+Reference analog: the ProgramDesc build + (Standalone)Executor run split —
+python/paddle/fluid/framework.py Program/Block (OpDescs appended by the
+LayerHelper as API calls are made) executed by
+paddle/fluid/framework/new_executor/interpretercore.cc over a feed/fetch
+contract (python/paddle/fluid/executor.py:1387 Executor.run).
+
+TPU-native mapping: "append an OpDesc" = record the jax-traceable pure_fn
+that apply_op (core/tensor.py) already routes every framework op through,
+together with its input/output Tensor identities. The op list IS the
+program. Executor.run replays the list as one pure function of
+(feeds, captured state) and jit-compiles it per feed signature — XLA
+plays InterpreterCore, the jaxpr plays ProgramDesc, and the compiled-
+executable cache plays _ExecutorCache (executor.py:750). Parameters enter
+as arguments (not baked constants), so optimizer updates between runs are
+picked up without retracing; their update itself rides the eager
+optimizer (`Optimizer.step`) on grads computed inside the same jit.
+
+Build-time evaluation note: ops run eagerly on placeholder zeros while
+the program is being built (shape inference for free — the InferMeta
+analog); the recorded pure_fns are shape-polymorphic jnp code, so
+Executor.run may feed any batch size regardless of the placeholder's.
+Layers that mutate their own state outside the op stream (BatchNorm
+running stats) update at build time only — inside Executor.run the
+replay is pure; use eager/hapi training where live running-stat updates
+matter.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Program", "Executor", "program_guard", "default_main_program",
+           "default_startup_program", "enable_static", "disable_static",
+           "in_static_mode", "data"]
+
+
+class _OpRecord:
+    __slots__ = ("pure_fn", "inputs", "outputs", "op_name")
+
+    def __init__(self, pure_fn, inputs, outputs, op_name):
+        self.pure_fn = pure_fn
+        self.inputs = list(inputs)    # Tensor refs (live: see run notes)
+        self.outputs = list(outputs)  # Tensor refs
+        self.op_name = op_name
+
+
+class Program:
+    """An op list + feed registry, recorded while the program is active."""
+
+    def __init__(self):
+        self._ops: List[_OpRecord] = []
+        self._feeds: Dict[str, Tensor] = {}
+        self._opt = None          # (optimizer, loss Tensor) from minimize
+        self._cache: Dict[tuple, object] = {}
+
+    # -- build side ---------------------------------------------------------
+    def _record(self, pure_fn, inputs, outputs, op_name):
+        self._ops.append(_OpRecord(pure_fn, inputs, outputs, op_name))
+        self._cache.clear()
+
+    def _add_feed(self, name: str, t: Tensor):
+        if name in self._feeds:
+            raise ValueError(f"duplicate feed var name {name!r}")
+        self._feeds[name] = t
+
+    def clone(self, for_test=False):
+        """Share the recorded graph; a for_test clone drops the optimizer
+        (reference: Program.clone(for_test=True) strips backward ops)."""
+        p = Program()
+        p._ops = self._ops
+        p._feeds = self._feeds
+        p._opt = None if for_test else self._opt
+        return p
+
+    def global_block(self):
+        return self
+
+    def var(self, name: str) -> Tensor:
+        if name in self._feeds:
+            return self._feeds[name]
+        for rec in self._ops:
+            for t in rec.outputs:
+                if getattr(t, "name", None) == name:
+                    return t
+        raise KeyError(f"no var named {name!r} in program")
+
+    def list_vars(self):
+        seen, out = set(), []
+        for t in self._feeds.values():
+            seen.add(id(t))
+            out.append(t)
+        for rec in self._ops:
+            for t in rec.outputs:
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+    # -- run-side helpers ---------------------------------------------------
+    def _captured(self) -> List[Tensor]:
+        """Inputs that are neither feeds nor op outputs: parameters and
+        build-time constants. Their LIVE arrays become jit arguments."""
+        produced = {id(t) for rec in self._ops for t in rec.outputs}
+        feed_ids = {id(t) for t in self._feeds.values()}
+        seen, caps = set(), []
+        for rec in self._ops:
+            for t in rec.inputs:
+                tid = id(t)
+                if tid in produced or tid in feed_ids or tid in seen:
+                    continue
+                seen.add(tid)
+                caps.append(t)
+        return caps
+
+    def _replay(self, env: Dict[int, object]):
+        """env: tensor-id -> array for feeds+captured; fills op outputs."""
+        for rec in self._ops:
+            arrs = [env[id(t)] for t in rec.inputs]
+            out = rec.pure_fn(*arrs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            for t, o in zip(rec.outputs, outs):
+                env[id(t)] = o
+        return env
+
+
+# --------------------------------------------------------------------------
+# active-program state (build-time recording)
+# --------------------------------------------------------------------------
+
+class _StaticState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.program_stack: List[Program] = []
+
+
+_state = _StaticState()
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def recording_program() -> Optional[Program]:
+    if _state.program_stack:
+        return _state.program_stack[-1]
+    if _state.enabled:
+        return _main_program
+    return None
+
+
+def enable_static():
+    """paddle.enable_static parity: API calls now append ops to the
+    default main program instead of (only) executing eagerly."""
+    from ..core import tensor as tensor_mod
+    _state.enabled = True
+    tensor_mod._STATIC_RECORD_HOOK[0] = _record_hook
+
+
+def disable_static():
+    from ..core import tensor as tensor_mod
+    _state.enabled = False
+    if not _state.program_stack:
+        tensor_mod._STATIC_RECORD_HOOK[0] = None
+
+
+def in_static_mode() -> bool:
+    return recording_program() is not None
+
+
+def _record_hook(pure_fn, inputs, outputs, op_name):
+    prog = recording_program()
+    if prog is not None:
+        prog._record(pure_fn, inputs, outputs, op_name)
+
+
+class program_guard:
+    """Context manager scoping recording to the given programs
+    (reference: paddle.static.program_guard)."""
+
+    def __init__(self, main_program: Program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        from ..core import tensor as tensor_mod
+        global _main_program, _startup_program
+        self._prev = (_main_program, _startup_program)
+        _main_program = self._main
+        if self._startup is not None:
+            _startup_program = self._startup
+        _state.program_stack.append(self._main)
+        tensor_mod._STATIC_RECORD_HOOK[0] = _record_hook
+        return self._main
+
+    def __exit__(self, *exc):
+        from ..core import tensor as tensor_mod
+        global _main_program, _startup_program
+        _main_program, _startup_program = self._prev
+        _state.program_stack.pop()
+        if not _state.program_stack and not _state.enabled:
+            tensor_mod._STATIC_RECORD_HOOK[0] = None
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Feed placeholder: a named Tensor whose build-time value is zeros
+    (None/-1 dims as 1); Executor.run substitutes the fed batch.
+    Reference: paddle.static.data returns a Variable in the current
+    program; same contract here."""
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    concrete = [1 if (d is None or int(d) < 0) else int(d) for d in shape]
+    t = Tensor(jnp.zeros(concrete, convert_dtype(dtype)),
+               stop_gradient=True)
+    t.name = name
+    prog = recording_program()
+    if prog is None:
+        raise RuntimeError(
+            "static.data requires an active program: call "
+            "paddle.enable_static() or use static.program_guard")
+    prog._add_feed(name, t)
+    return t
+
+
+# --------------------------------------------------------------------------
+# Executor
+# --------------------------------------------------------------------------
+
+class Executor:
+    """Compiles + runs recorded programs (InterpreterCore analog: the op
+    list becomes one jitted function per (feed signature, fetch set))."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        if callable(program) and not isinstance(program, Program):
+            # legacy convenience: run a jitted/static function directly
+            args = [v for v in (feed or {}).values()]
+            out = program(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o.numpy() if isinstance(o, Tensor) else o
+                    for o in outs] if return_numpy else list(outs)
+        program = program if program is not None else _main_program
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if not program._ops and not fetch_list:
+            return []  # startup program: params initialized at build
+
+        import jax.numpy as jnp
+        feeds = sorted(program._feeds.items())
+        missing = [n for n, _ in feeds if n not in feed]
+        if missing:
+            raise ValueError(f"missing feeds: {missing}")
+        feed_arrays = [jnp.asarray(np.asarray(feed[n])) for n, _ in feeds]
+        caps = program._captured()
+        cap_arrays = [t._array for t in caps]
+        fetch_ids = tuple(id(t) for t in fetch_list)
+
+        train = program._opt is not None
+        key = (len(program._ops), fetch_ids, train,
+               tuple((a.shape, str(a.dtype)) for a in feed_arrays))
+        fn = program._cache.get(key)
+        if fn is None:
+            fn = self._build(program, feeds, caps, fetch_list, train)
+            program._cache[key] = fn
+
+        if train:
+            opt, _loss = program._opt
+            trainable = [t for t in caps if not t.stop_gradient]
+            if not opt._parameter_list:
+                # minimize() during build could not know the program's
+                # trainables yet; bind them now (stable order: capture
+                # order, which is op order)
+                opt._parameter_list = trainable
+            fetch_vals, grads = fn(feed_arrays, cap_arrays)
+            for p, g in zip(trainable, grads):
+                p.grad = Tensor(g)
+            opt.step()
+            opt.clear_grad()
+        else:
+            fetch_vals = fn(feed_arrays, cap_arrays)
+        if return_numpy:
+            return [np.asarray(v) for v in fetch_vals]
+        return [Tensor(v) for v in fetch_vals]
+
+    def _build(self, program, feeds, caps, fetch_list, train):
+        feed_ts = [t for _, t in feeds]
+        trainable_idx = [i for i, t in enumerate(caps)
+                         if not t.stop_gradient]
+
+        def forward(feed_arrays, cap_arrays):
+            env = {id(t): a for t, a in zip(feed_ts, feed_arrays)}
+            env.update({id(t): a for t, a in zip(caps, cap_arrays)})
+            program._replay(env)
+            return [env[id(t)] for t in fetch_list], env
+
+        if not train:
+            @jax.jit
+            def infer(feed_arrays, cap_arrays):
+                return forward(feed_arrays, cap_arrays)[0]
+            return infer
+
+        opt, loss_t = program._opt
+
+        @jax.jit
+        def train_step(feed_arrays, cap_arrays):
+            def loss_of(train_arrays):
+                full = list(cap_arrays)
+                for i, a in zip(trainable_idx, train_arrays):
+                    full[i] = a
+                fetches, env = forward(feed_arrays, full)
+                return env[id(loss_t)].astype(jax.numpy.float32).sum(), \
+                    fetches
+            train_arrays = [cap_arrays[i] for i in trainable_idx]
+            (_, fetches), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_arrays)
+            return fetches, grads
+
+        return train_step

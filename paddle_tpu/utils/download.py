@@ -75,22 +75,28 @@ def _fetch(url: str, dst: str):
     egress, pointing at the local-path alternative)."""
     if url.startswith("file://"):
         url = url[len("file://"):]
-    if os.path.exists(url):
-        shutil.copy(url, dst)
-        return
-    if url.startswith(("http://", "https://")):
-        import urllib.request
-        try:
-            with urllib.request.urlopen(url, timeout=60) as r, \
-                    open(dst, "wb") as f:
-                shutil.copyfileobj(r, f)
-            return
-        except Exception as e:
-            raise RuntimeError(
-                f"download of {url} failed ({e}); on air-gapped hosts, "
-                f"place the file locally and pass its path, or pre-seed "
-                f"the cache at {os.path.dirname(dst)}") from e
-    raise FileNotFoundError(f"no such artifact source: {url}")
+    tmp = dst + ".tmp"  # never leave a truncated file at the cache path:
+    try:                # a later md5sum=None call would serve it as valid
+        if os.path.exists(url):
+            shutil.copy(url, tmp)
+        elif url.startswith(("http://", "https://")):
+            import urllib.request
+            try:
+                with urllib.request.urlopen(url, timeout=60) as r, \
+                        open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+            except Exception as e:
+                raise RuntimeError(
+                    f"download of {url} failed ({e}); on air-gapped "
+                    f"hosts, place the file locally and pass its path, "
+                    f"or pre-seed the cache at {os.path.dirname(dst)}"
+                ) from e
+        else:
+            raise FileNotFoundError(f"no such artifact source: {url}")
+        os.replace(tmp, dst)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
