@@ -48,9 +48,53 @@ def py_func(func, x, out, backward_func=None):
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    raise NotImplementedError(
-        "save_inference_model: use paddle_tpu.jit.save(layer, path, "
-        "input_spec=...) — the StableHLO serving path")
+    """Export a static Program's feed→fetch slice as the same StableHLO
+    artifact jit.save writes (reference: static/io.py save_inference_model
+    prunes the ProgramDesc to the feed/fetch subgraph; here the replay fn
+    IS the pruned graph, with captured parameters frozen at save time).
+    Loadable by load_inference_model / jit.load / inference.Predictor and
+    the native C serving ABI. Shapes export at the placeholders' build
+    shapes (dynamic dims as 1), matching jit.save's contract."""
+    import os
+    import pickle
+
+    import jax
+    from jax import export as jexport
+
+    from ..framework.io import save as fsave
+    from .program import default_main_program
+
+    program = program if program is not None else default_main_program()
+    feed_vars = list(feed_vars) if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = list(fetch_vars) if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    caps = program._captured()
+    params = {f"__cap_{i}": t._array for i, t in enumerate(caps)}
+
+    def pure_forward(params_in, *feed_arrays):
+        env = {id(t): a for t, a in zip(feed_vars, feed_arrays)}
+        env.update({id(t): params_in[f"__cap_{i}"]
+                    for i, t in enumerate(caps)})
+        program._replay(env)
+        outs = [env[id(t)] for t in fetch_vars]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    abstract = [jax.ShapeDtypeStruct(t._array.shape, t._array.dtype)
+                for t in feed_vars]
+    exported = jexport.export(jax.jit(pure_forward))(
+        {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+         for k, v in params.items()}, *abstract)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    fsave({k: Tensor(v) for k, v in params.items()},
+          path_prefix + ".pdiparams")
+    with open(path_prefix + ".meta", "wb") as f:
+        pickle.dump({"input_specs": [(list(t._array.shape),
+                                      str(t._array.dtype))
+                                     for t in feed_vars]}, f)
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

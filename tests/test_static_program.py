@@ -138,3 +138,28 @@ def test_eager_mode_unaffected_after_disable():
     # into the default program)
     from paddle_tpu.core import tensor as tensor_mod
     assert tensor_mod._STATIC_RECORD_HOOK[0] is None
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    main = static.Program()
+    with static.program_guard(main):
+        paddle.seed(3)
+        x = static.data("x", [4, 6])
+        h = static.nn.fc(x, 8, activation="relu")
+        out = static.nn.fc(h, 2)
+    exe = static.Executor()
+    xs = np.random.default_rng(5).standard_normal((4, 6)).astype("float32")
+    ref, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+
+    prefix = str(tmp_path / "static_model")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    loaded = static.load_inference_model(prefix)
+    got = loaded(xs)
+    got = got.numpy() if hasattr(got, "numpy") else got[0].numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # and through the serving Predictor
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+    outs = pred.run([xs])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
