@@ -1,0 +1,14 @@
+"""Flagship model families (functional cores + Layer facades).
+
+- llama: RoPE/GQA/SwiGLU decoder with 4-D parallel train step (the
+  Llama-2 pretrain north star), optional MoE layers, ring-attention CP.
+- gpt: GPT-2-style decoder (learned positions, fused QKV, GELU, tied head).
+- ernie: encoder pretraining family (MLM+NSP).
+- decoding: shared KV-cache autoregressive generation.
+"""
+from . import llama  # noqa: F401
+from . import gpt  # noqa: F401
+from . import ernie  # noqa: F401
+from . import decoding  # noqa: F401
+
+__all__ = ["llama", "gpt", "ernie", "decoding"]

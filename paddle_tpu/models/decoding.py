@@ -1,0 +1,181 @@
+"""Autoregressive decoding with KV caches — the inference half of the
+model families.
+
+Reference analog: the fused inference transformer stack
+(paddle/fluid/operators/fused/fused_multi_transformer_op.cu — per-layer
+KV cache updated in place, one token per step) and PaddleNLP's
+generate() loop. TPU-native shape: the cache is a stacked [L, B, S_max,
+kv_heads, head_dim] pair updated with lax.dynamic_update_slice inside a
+jit-compiled step; the whole decode loop is one lax.scan, so the chip
+never returns to the host between tokens. Prefill processes the prompt
+as a single chunk (same code path, T=prompt_len), matching how the
+reference separates context-encode from decode phases.
+
+Model-agnostic core: cached_attention_core() attends new-chunk queries
+over the cache; each model family computes its own q/k/v (rope or
+learned positions) and MLP around it.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["KVCache", "init_kv_cache", "cached_attention_core",
+           "sample_logits", "generate_tokens", "model_generate"]
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S_max, n_kv_heads, head_dim]
+    v: jnp.ndarray
+
+
+def init_kv_cache(num_layers, batch, max_len, n_kv_heads, head_dim,
+                  dtype=jnp.bfloat16) -> KVCache:
+    shape = (num_layers, batch, max_len, n_kv_heads, head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def cached_attention_core(q, k_new, v_new, cache_k, cache_v, pos):
+    """q/k_new/v_new: [B, T, h, d] for the current chunk starting at
+    ``pos`` (traced scalar); cache_k/v: [B, S_max, kv_h, d] for one
+    layer. Returns (out [B, T, h, d], new_ck, new_cv).
+    GQA: q is viewed as [B, T, kv_h, rep, d] and contracted directly
+    against the kv-width cache — the K/V tensors are never expanded to
+    q-head width (the memory that matters at long context)."""
+    B, T, nh, d = q.shape
+    S_max = cache_k.shape[1]
+    nkv = cache_k.shape[2]
+    cache_k = lax.dynamic_update_slice(
+        cache_k, k_new.astype(cache_k.dtype), (0, pos, 0, 0))
+    cache_v = lax.dynamic_update_slice(
+        cache_v, v_new.astype(cache_v.dtype), (0, pos, 0, 0))
+    scale = 1.0 / (d ** 0.5)
+    q_pos = pos + jnp.arange(T)
+    key_pos = jnp.arange(S_max)
+    mask = key_pos[None, :] <= q_pos[:, None]          # [T, S_max]
+    rep = nh // nkv
+    # q head h attends kv head h // rep (the jnp.repeat layout)
+    qg = q.reshape(B, T, nkv, rep, d).astype(jnp.float32)
+    kf = cache_k.astype(jnp.float32)
+    vf = cache_v.astype(jnp.float32)
+    logits = jnp.einsum("btkrd,bskd->bkrts", qg, kf) * scale
+    logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkrts,bskd->btkrd", probs, vf)
+    return (out.reshape(B, T, nh, d).astype(q.dtype),
+            cache_k, cache_v)
+
+
+def sample_logits(logits, temperature: float, top_k: int, rng):
+    """logits: [B, V] fp32. temperature==0 -> greedy; else softmax sample
+    with optional top-k filtering. temperature/top_k are trace-time
+    constants (python numbers)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+# compiled generate loops, keyed by (model key, shapes, sampling config)
+# so repeated generate() calls with the same signature reuse the
+# executable instead of retracing prefill + scan every time
+_RUN_CACHE: dict = {}
+
+
+def generate_tokens(forward_with_cache: Callable, params, input_ids,
+                    cache: KVCache, max_new_tokens: int,
+                    temperature: float = 0.0, top_k: int = 0,
+                    rng=None, eos_token_id: Optional[int] = None,
+                    cache_key=None):
+    """Shared generate loop: prefill the prompt, then lax.scan one token
+    at a time. ``forward_with_cache(params, tokens[B,T], cache, pos) ->
+    (logits[B,T,V] fp32, cache)``. Returns [B, max_new_tokens] int32;
+    positions after eos are filled with eos. Pass a hashable
+    ``cache_key`` identifying the model/config so the compiled loop is
+    reused across calls (model_generate does)."""
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    B, P = input_ids.shape
+
+    key = (cache_key if cache_key is not None else id(forward_with_cache),
+           B, P, int(max_new_tokens), float(temperature), int(top_k),
+           eos_token_id, cache.k.shape, str(cache.k.dtype))
+    run = _RUN_CACHE.get(key)
+    if run is None:
+        def run_impl(params, input_ids, cache, rng):
+            logits, cache = forward_with_cache(params, input_ids, cache, 0)
+            rng, sub = jax.random.split(rng)
+            tok = sample_logits(logits[:, -1], temperature, top_k, sub)
+            finished = jnp.zeros((B,), jnp.bool_)
+            if eos_token_id is not None:
+                finished = tok == eos_token_id
+
+            def body(carry, _):
+                cache, tok, pos, rng, finished = carry
+                logits, cache = forward_with_cache(params, tok[:, None],
+                                                   cache, pos)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_logits(logits[:, 0], temperature, top_k, sub)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                return (cache, nxt, pos + 1, rng, finished), nxt
+
+            (cache, _, _, _, _), rest = lax.scan(
+                body, (cache, tok, jnp.int32(P), rng, finished), None,
+                length=max_new_tokens - 1)
+            return jnp.concatenate(
+                [tok[:, None], rest.T.astype(jnp.int32)], axis=1)
+
+        run = jax.jit(run_impl)
+        _RUN_CACHE[key] = run
+    return run(params, input_ids, cache, rng)
+
+
+class GenerationMixin:
+    """Layer-facade generate(): set ``_generate_fn`` to the family's
+    functional generate (cfg, params, ids, ...) and inherit."""
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, seed=0, eos_token_id=None):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+
+        ids = np.asarray(input_ids._array
+                         if isinstance(input_ids, Tensor) else input_ids)
+        fn = type(self)._generate_fn
+        out = fn(self.config, self._tree(), jnp.asarray(ids),
+                 max_new_tokens, temperature=temperature, top_k=top_k,
+                 rng=jax.random.PRNGKey(seed), eos_token_id=eos_token_id)
+        return Tensor(out)
+
+
+def model_generate(forward_with_cache: Callable, *, num_layers: int,
+                   kv_heads: int, head_dim: int, max_positions: int,
+                   cache_dtype, cache_key, params, input_ids,
+                   max_new_tokens: int, temperature: float = 0.0,
+                   top_k: int = 0, rng=None,
+                   eos_token_id: Optional[int] = None):
+    """The one generate() wrapper every model family shares: bounds
+    check against the positional-embedding budget, cache allocation at
+    kv-head width, memoized compiled loop."""
+    B, P = input_ids.shape
+    max_len = P + max_new_tokens
+    if max_len > max_positions:
+        raise ValueError(
+            f"prompt {P} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_position_embeddings {max_positions}")
+    cache = init_kv_cache(num_layers, B, max_len, kv_heads, head_dim,
+                          dtype=cache_dtype)
+    return generate_tokens(forward_with_cache, params,
+                           jnp.asarray(input_ids), cache, max_new_tokens,
+                           temperature=temperature, top_k=top_k, rng=rng,
+                           eos_token_id=eos_token_id,
+                           cache_key=cache_key)

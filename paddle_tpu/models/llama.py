@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P, NamedSharding
 
+from .decoding import GenerationMixin
+
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "init_params", "forward_pure",
            "build_train_step", "param_specs"]
 
@@ -333,6 +335,77 @@ def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None,
 
 
 # ---------------------------------------------------------------------------
+# KV-cache inference (models/decoding.py core)
+# ---------------------------------------------------------------------------
+
+def forward_with_cache(cfg: LlamaConfig, params, tokens, cache, pos):
+    """Chunked cached forward: process ``tokens`` [B, T] starting at
+    sequence offset ``pos`` against per-layer KV caches. For dense
+    configs this is the same math as forward_pure (rope at absolute
+    positions, GQA-width cache) — cached greedy decode reproduces the
+    uncached forward token-for-token (asserted in test_generation).
+    MoE configs decode with per-chunk capacity (C computed from the
+    chunk's tokens, so single-token steps are effectively dropless); this
+    intentionally differs from the training forward, whose GShard
+    capacity makes tokens compete across the whole sequence. Serves both
+    prefill (T=prompt) and decode (T=1)."""
+    from .decoding import KVCache, cached_attention_core
+
+    B, T = tokens.shape
+    nh, nkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, \
+        cfg.head_dim
+    H = cfg.hidden_size
+    sin_full, cos_full = _rope_tables(cfg, cfg.max_position_embeddings)
+    sin = lax.dynamic_slice_in_dim(sin_full, pos, T, axis=0)
+    cos = lax.dynamic_slice_in_dim(cos_full, pos, T, axis=0)
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def body(h, inp):
+        lp, ck, cv = inp
+        xn = _rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        q = _apply_rope((xn @ lp["wq"]).reshape(B, T, nh, d), sin, cos)
+        k = _apply_rope((xn @ lp["wk"]).reshape(B, T, nkv, d), sin, cos)
+        v = (xn @ lp["wv"]).reshape(B, T, nkv, d)
+        out, ck, cv = cached_attention_core(q, k, v, ck, cv, pos)
+        h = h + out.reshape(B, T, H) @ lp["wo"]
+        hn = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        if cfg.moe_num_experts > 0:
+            mlp_out, _aux = _moe_mlp(cfg, lp, hn)
+            h = h + mlp_out
+        else:
+            h = h + _dense_mlp(lp, hn)
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["layers"], cache.k, cache.v))
+    x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, KVCache(new_k, new_v)
+
+
+def _cfg_key(cfg):
+    return tuple(sorted((k, str(v))
+                        for k, v in dataclasses.asdict(cfg).items()))
+
+
+def generate(cfg: LlamaConfig, params, input_ids, max_new_tokens,
+             temperature=0.0, top_k=0, rng=None, eos_token_id=None):
+    """[B, P] prompt -> [B, max_new_tokens] continuations, whole decode
+    loop on device (one compiled scan, memoized per signature)."""
+    from .decoding import model_generate
+
+    return model_generate(
+        functools.partial(forward_with_cache, cfg),
+        num_layers=cfg.num_hidden_layers,
+        kv_heads=cfg.num_key_value_heads, head_dim=cfg.head_dim,
+        max_positions=cfg.max_position_embeddings, cache_dtype=cfg.dtype,
+        cache_key=("llama", _cfg_key(cfg)), params=params,
+        input_ids=input_ids, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, rng=rng,
+        eos_token_id=eos_token_id)
+
+
+# ---------------------------------------------------------------------------
 # parallel train step
 # ---------------------------------------------------------------------------
 
@@ -482,7 +555,7 @@ from ..nn.layer.layers import Layer, Parameter  # noqa: E402
 from ..core.tensor import Tensor, apply_op  # noqa: E402
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
     """Eager/dygraph face over the functional core: parameters are the same
     stacked pytree exposed as Layer parameters, so state_dict naming is
     stable and the eager forward matches forward_pure bit-for-bit."""
@@ -518,6 +591,9 @@ class LlamaForCausalLM(Layer):
         ids_t = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(jnp.asarray(np.asarray(input_ids)))
         logits = apply_op(_f, ids_t, *tensors, op_name="llama_forward")
+        return self._maybe_loss(logits, labels)
+
+    def _maybe_loss(self, logits, labels):
         if labels is not None:
             from ..nn import functional as F
             from ..tensor.manipulation import reshape
@@ -548,3 +624,6 @@ def _unflatten_params(flat):
             node = node.setdefault(p, {})
         node[parts[-1]] = v
     return tree
+
+
+LlamaForCausalLM._generate_fn = staticmethod(generate)
