@@ -10,5 +10,6 @@ from . import llama  # noqa: F401
 from . import gpt  # noqa: F401
 from . import ernie  # noqa: F401
 from . import decoding  # noqa: F401
+from . import convert  # noqa: F401
 
-__all__ = ["llama", "gpt", "ernie", "decoding"]
+__all__ = ["llama", "gpt", "ernie", "decoding", "convert"]

@@ -1,0 +1,147 @@
+"""Checkpoint name compatibility: PaddleNLP/HF llama state_dicts <-> the
+stacked parameter pytree.
+
+Reference analog: the state_dict naming contract that lets
+paddle.save/load checkpoints flow between PaddleNLP trainers
+(python/paddle/framework/io.py pickled nested state_dicts keyed by
+parameter name). The TPU build stacks per-layer weights along a leading
+L axis for lax.scan/GSPMD, so loading an external checkpoint means
+de-interleaving "layers.{i}.<leaf>" names into stacked arrays — this
+module is that bridge, in both directions.
+
+Name schema (PaddleNLP LlamaForCausalLM, also HF transformers modulo the
+"llama."/"model." prefix):
+  {p}.embed_tokens.weight                         -> embed
+  {p}.layers.{i}.input_layernorm.weight           -> layers.ln1[i]
+  {p}.layers.{i}.self_attn.{q,k,v,o}_proj.weight  -> layers.w{q,k,v,o}[i]
+  {p}.layers.{i}.post_attention_layernorm.weight  -> layers.ln2[i]
+  {p}.layers.{i}.mlp.{gate,up,down}_proj.weight   -> layers.w_{gate,up,down}[i]
+  {p}.norm.weight                                 -> norm_f
+  lm_head.weight                                  -> lm_head
+
+Orientation: paddle Linear weights are [in, out] — the same layout the
+stacked pytree multiplies with (x @ w) — so PaddleNLP sources load
+without transposition; HF/torch Linear stores [out, in], so
+``source="hf"`` transposes the projection matrices.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["llama_from_external_state_dict", "llama_to_external_state_dict"]
+
+_LEAF_MAP = {
+    "input_layernorm.weight": "ln1",
+    "self_attn.q_proj.weight": "wq",
+    "self_attn.k_proj.weight": "wk",
+    "self_attn.v_proj.weight": "wv",
+    "self_attn.o_proj.weight": "wo",
+    "post_attention_layernorm.weight": "ln2",
+    "mlp.gate_proj.weight": "w_gate",
+    "mlp.up_proj.weight": "w_up",
+    "mlp.down_proj.weight": "w_down",
+}
+_MATRIX_LEAVES = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+_PREFIXES = ("llama.", "model.", "")
+
+
+def _to_np(v):
+    if hasattr(v, "_array"):  # Tensor facade
+        v = v._array
+    if hasattr(v, "numpy"):
+        try:
+            v = v.numpy()
+        except Exception:
+            pass
+    return np.asarray(v)
+
+
+def _strip_prefix(name: str) -> str:
+    for p in ("llama.", "model."):
+        if name.startswith(p):
+            return name[len(p):]
+    return name
+
+
+def llama_from_external_state_dict(cfg, state_dict: Dict[str, Any],
+                                   source: str = "paddlenlp",
+                                   strict: bool = True):
+    """Per-layer external names -> the stacked pytree init_params builds.
+    ``source``: "paddlenlp" (weights [in, out], used as-is) or "hf"
+    (torch [out, in]; projections transposed). With ``strict``, missing
+    or unknown keys raise with the full lists."""
+    if source not in ("paddlenlp", "hf"):
+        raise ValueError(f"unknown source {source!r}")
+    transpose = source == "hf"
+    L = cfg.num_hidden_layers
+    sd = {_strip_prefix(k): v for k, v in state_dict.items()}
+
+    per_layer = {leaf: [None] * L for leaf in _LEAF_MAP.values()}
+    top = {}
+    unknown = []
+    for name, v in sd.items():
+        arr = _to_np(v)
+        if name == "embed_tokens.weight":
+            top["embed"] = arr
+        elif name == "norm.weight":
+            top["norm_f"] = arr
+        elif name == "lm_head.weight":
+            # lm_head multiplies [H] -> [V]: paddle stores [H, V]; hf [V, H]
+            top["lm_head"] = arr.T if transpose else arr
+        elif name.startswith("layers."):
+            _, idx, leaf = name.split(".", 2)
+            i = int(idx)
+            mapped = _LEAF_MAP.get(leaf)
+            if mapped is None or i >= L:
+                unknown.append(name)
+                continue
+            if transpose and mapped in _MATRIX_LEAVES:
+                arr = arr.T
+            per_layer[mapped][i] = arr
+        else:
+            unknown.append(name)
+
+    missing = [k for k in ("embed", "norm_f", "lm_head") if k not in top]
+    for leaf, slots in per_layer.items():
+        missing += [f"layers.{i}.{leaf}" for i, s in enumerate(slots)
+                    if s is None]
+    if strict and (missing or unknown):
+        raise KeyError(
+            f"state_dict mismatch: missing={missing[:8]}"
+            f"{'...' if len(missing) > 8 else ''} unknown={unknown[:8]}")
+
+    dtype = cfg.dtype
+    layers = {leaf: jnp.asarray(np.stack(slots), dtype)
+              for leaf, slots in per_layer.items()
+              if all(s is not None for s in slots)}
+    return {
+        "embed": jnp.asarray(top["embed"], dtype),
+        "layers": layers,
+        "norm_f": jnp.asarray(top["norm_f"], dtype),
+        "lm_head": jnp.asarray(top["lm_head"], dtype),
+    }
+
+
+def llama_to_external_state_dict(cfg, params, prefix: str = "llama.",
+                                 source: str = "paddlenlp"):
+    """Stacked pytree -> per-layer external names (the inverse bridge, so
+    checkpoints trained here load into PaddleNLP/HF trainers)."""
+    transpose = source == "hf"
+    out = {
+        f"{prefix}embed_tokens.weight": np.asarray(params["embed"]),
+        f"{prefix}norm.weight": np.asarray(params["norm_f"]),
+        "lm_head.weight": (np.asarray(params["lm_head"]).T if transpose
+                           else np.asarray(params["lm_head"])),
+    }
+    inv = {v: k for k, v in _LEAF_MAP.items()}
+    for leaf, ext in inv.items():
+        stacked = np.asarray(params["layers"][leaf])
+        for i in range(stacked.shape[0]):
+            arr = stacked[i]
+            if transpose and leaf in _MATRIX_LEAVES:
+                arr = arr.T
+            out[f"{prefix}layers.{i}.{ext}"] = arr
+    return out
