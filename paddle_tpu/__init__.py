@@ -56,6 +56,39 @@ from . import hapi  # noqa: E402
 from . import device  # noqa: E402
 from . import static  # noqa: E402
 from .static.program import (enable_static, disable_static)  # noqa: E402
+from . import version  # noqa: E402
+
+__version__ = version.full_version
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .utils import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
+
+
+def disable_signal_handler():
+    """Parity no-op: the reference unhooks its C++ SIGSEGV/SIGBUS dump
+    handlers (paddle/fluid/platform/init.cc); this build installs none."""
+
+
+def get_cudnn_version():
+    return None  # no CUDA in the build (reference returns e.g. 8200)
+
+
+class LazyGuard:
+    """Reference: paddle.LazyGuard defers parameter materialization so
+    giant models can be sharded before init. TPU-native equivalent: use
+    the functional init path jitted with output shardings
+    (models/llama.py build_train_step init_fn) — arrays are then created
+    directly on their owning devices. This guard exists for source
+    compatibility; eager Layers under it initialize normally."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
 
 
 def in_dynamic_mode():

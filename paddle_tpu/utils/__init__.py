@@ -4,10 +4,40 @@ from __future__ import annotations
 import threading
 
 __all__ = ["unique_name", "try_import", "flops", "dlpack", "deprecated",
-           "cpp_extension", "download"]
+           "cpp_extension", "download", "run_check"]
 
 from . import cpp_extension
 from . import download
+
+
+def run_check():
+    """Install self-check (reference: paddle.utils.run_check — runs a
+    small program on the configured device(s) and reports). Exercises a
+    jitted matmul on the default device and, when several devices exist,
+    a psum across all of them."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    print("Running verify PaddlePaddle(TPU build) program ...")
+    dev = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    np.testing.assert_allclose(np.asarray(y[0, 0]), 128.0, rtol=1e-5)
+    n = jax.device_count()
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        xs = jax.device_put(jnp.ones((n, 4)),
+                            NamedSharding(mesh, P("dp", None)))
+        total = jax.jit(lambda a: jnp.sum(a))(xs)
+        np.testing.assert_allclose(float(total), n * 4.0)
+        print(f"PaddlePaddle(TPU build) works on {n} {dev.platform} "
+              "devices (collective check passed).")
+    else:
+        print(f"PaddlePaddle(TPU build) works on 1 {dev.platform} "
+              f"device ({getattr(dev, 'device_kind', dev)}).")
+    print("PaddlePaddle(TPU build) is installed successfully!")
 
 
 class _UniqueNameGenerator:

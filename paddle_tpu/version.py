@@ -1,0 +1,26 @@
+"""Version metadata (reference: python/paddle/version.py, generated at
+build time by setup.py; here maintained in-tree)."""
+from __future__ import annotations
+
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "in-tree"
+with_gpu = "OFF"     # no CUDA in the build — TPU/XLA only
+xla = "ON"
+
+
+def show():
+    print(f"paddle-tpu {full_version} (commit {commit}); "
+          f"backend: jax/XLA (cuda: {with_gpu.lower()})")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -108,3 +108,19 @@ def test_device_data_loader_wraps_any_iterable():
         np.testing.assert_array_equal(np.asarray(b), src[i])
     with pytest.raises(ValueError):
         DeviceDataLoader(src, buffer_size=0)
+
+
+def test_top_level_version_and_run_check(capsys):
+    assert paddle.__version__ == paddle.version.full_version
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+    assert paddle.get_cudnn_version() is None
+    paddle.disable_signal_handler()  # parity no-op must exist
+    with paddle.LazyGuard():
+        import paddle_tpu.nn as nn
+        nn.Linear(2, 2)
+    import numpy as np
+    net = __import__("paddle_tpu.nn", fromlist=["x"]).Sequential(
+        __import__("paddle_tpu.nn", fromlist=["x"]).Linear(8, 4))
+    assert paddle.flops(net, [1, 8]) == 64
