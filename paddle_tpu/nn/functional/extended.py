@@ -132,3 +132,45 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
 from ...ops.registry import register as _register  # noqa: E402
 for _n in __all__:
     _register(_n, globals()[_n])
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A002
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between token sequence batches (reference:
+    edit_distance op). Host-side DP like the reference CPU kernel —
+    dynamic lengths make this inherently sequential. Returns
+    (distances [B, 1] float32, sequence_num [1])."""
+    import numpy as _np
+
+    a = _np.asarray(_ensure_tensor(input)._array)
+    b = _np.asarray(_ensure_tensor(label)._array)
+    il = None if input_length is None else \
+        _np.asarray(_ensure_tensor(input_length)._array).reshape(-1)
+    ll = None if label_length is None else \
+        _np.asarray(_ensure_tensor(label_length)._array).reshape(-1)
+    ignored = set(ignored_tokens or [])
+    B = a.shape[0]
+    out = _np.zeros((B, 1), _np.float32)
+    for n in range(B):
+        s = a[n][:il[n]] if il is not None else a[n]
+        t = b[n][:ll[n]] if ll is not None else b[n]
+        s = [int(v) for v in s if int(v) not in ignored]
+        t = [int(v) for v in t if int(v) not in ignored]
+        dp = _np.arange(len(t) + 1, dtype=_np.float32)
+        for i in range(1, len(s) + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, len(t) + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (s[i - 1] != t[j - 1]))
+        d = dp[len(t)]
+        if normalized:
+            d = d / max(len(t), 1)
+        out[n, 0] = d
+    from ...core.tensor import Tensor as _T
+    import jax.numpy as _jnp
+    return _T(_jnp.asarray(out)), _T(_jnp.asarray(_np.asarray([B], _np.int64)))
+
+
+_register("edit_distance", edit_distance)
+__all__ += ["edit_distance"]

@@ -20,6 +20,7 @@ __all__ = [
     "hinge_embedding_loss", "triplet_margin_loss", "ctc_loss", "square_error_cost",
     "sigmoid_focal_loss", "log_loss", "npair_loss", "softmax_cross_entropy_with_logits",
     "multi_label_soft_margin_loss", "soft_margin_loss", "poisson_nll_loss",
+    "rnnt_loss", "hsigmoid_loss",
 ]
 
 
@@ -409,6 +410,138 @@ def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
             per = per + jnp.where(y > 1, stirling, 0.0)
         return _reduce(per, reduction)
     return apply_op(_f, input, label, op_name="poisson_nll_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN transducer loss (Graves 2012).
+
+    Reference analog: the warprnnt-backed op (paddle/phi/kernels/...
+    warprnnt; python face paddle.nn.functional.rnnt_loss). TPU-native: the
+    alpha recursion runs as a lax.scan over time with the inner
+    label-dimension recurrence closed by an associative log-cumsum-exp, so
+    the whole DP compiles to one fused loop — no host round trips.
+
+    input: [B, T, U+1, V] logits; label: [B, U] int; lengths per sample.
+    """
+    from jax import lax as _lax
+
+    input = _ensure_tensor(input)  # noqa: A001
+    label = _ensure_tensor(label)
+    input_lengths = _ensure_tensor(input_lengths)
+    label_lengths = _ensure_tensor(label_lengths)
+
+    def _f(logits, labels, t_lens, u_lens):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        blank_lp = lp[..., blank]                          # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], labels[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                               # [B, T, U]
+        if fastemit_lambda:
+            # FastEmit (Yu et al. 2021), as warprnnt implements it: the
+            # loss VALUE is unchanged; gradients through label emissions
+            # are scaled by (1 + lambda). value-preserving grad-scale:
+            lam = float(fastemit_lambda)
+            lab_lp = lab_lp * (1.0 + lam) \
+                - jax.lax.stop_gradient(lab_lp * lam)
+
+        def row(alpha_prev, t):
+            # alpha_t[u] = logaddexp(alpha_prev[u] + blank_prev[u],
+            #                        alpha_t[u-1] + lab[t, u-1])
+            # closed form: c[u] = cumsum_pad(lab[t]); alpha_t =
+            #   c + logcumsumexp(alpha_prev + blank_prev - c)
+            lab_t = lab_lp[:, t, :]                        # [B, U]
+            c = jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.float32),
+                 jnp.cumsum(lab_t, axis=-1)], axis=-1)     # [B, U+1]
+            g = alpha_prev + blank_lp[:, t - 1, :] - c
+            acc = _lax.associative_scan(jnp.logaddexp, g, axis=-1)
+            return c + acc
+
+        # t = 0 row: alpha[0, u] = sum_{j<u} lab[0, j]
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32),
+             jnp.cumsum(lab_lp[:, 0, :], axis=-1)], axis=-1)
+
+        def step(alpha, t):
+            nxt = row(alpha, t)
+            return nxt, alpha
+
+        alpha_T, rows = _lax.scan(step, alpha0,
+                                  jnp.arange(1, T))
+        all_rows = jnp.concatenate([rows,
+                                    alpha_T[None]], axis=0)  # [T, B, U+1]
+        all_rows = jnp.moveaxis(all_rows, 0, 1)              # [B, T, U+1]
+        tb = jnp.clip(t_lens.astype(jnp.int32) - 1, 0, T - 1)
+        ub = jnp.clip(u_lens.astype(jnp.int32), 0, U)
+        bidx = jnp.arange(B)
+        alpha_end = all_rows[bidx, tb, ub]
+        final_blank = blank_lp[bidx, tb, ub]
+        per = -(alpha_end + final_blank)
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    return apply_op(_f, input, label, input_lengths, label_lengths,
+                    op_name="rnnt_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: hsigmoid_loss op /
+    python/paddle/nn/functional/loss.py): binary decisions along each
+    class's path through a code tree. Custom trees pass
+    path_table/path_code; the default is the complete binary tree over
+    num_classes leaves (heap numbering), whose paths are derived from
+    the labels on the host — call eagerly or precompute tables for jit.
+    """
+    import numpy as _np
+
+    input = _ensure_tensor(input)  # noqa: A001
+    label = _ensure_tensor(label)
+    weight = _ensure_tensor(weight)
+    if path_table is None or path_code is None:
+        lab = _np.asarray(label._array).reshape(-1)
+        depth = max(1, int(_np.ceil(_np.log2(max(num_classes, 2)))))
+        tables = _np.full((len(lab), depth), -1, _np.int64)
+        codes = _np.zeros((len(lab), depth), _np.float32)
+        for n, c in enumerate(lab):
+            node = int(c) + num_classes
+            path = []
+            while node > 1:
+                path.append((node // 2 - 1, node & 1))
+                node //= 2
+            for d, (idx, bit) in enumerate(reversed(path)):
+                tables[n, d] = idx
+                codes[n, d] = bit
+        path_table = Tensor(jnp.asarray(tables))
+        path_code = Tensor(jnp.asarray(codes))
+    else:
+        path_table = _ensure_tensor(path_table)
+        path_code = _ensure_tensor(path_code)
+    args = [input, weight, path_table, path_code]
+    if bias is not None:
+        args.append(_ensure_tensor(bias))
+
+    def _f(x, w, tbl, code, *b):
+        mask = (tbl >= 0).astype(jnp.float32)              # [N, L]
+        safe = jnp.clip(tbl, 0, w.shape[0] - 1)
+        wrows = w[safe]                                    # [N, L, D]
+        z = jnp.einsum("nld,nd->nl", wrows.astype(jnp.float32),
+                       x.astype(jnp.float32))
+        if b:
+            # bias is documented as [num_classes-1, 1] (also accept 1-D)
+            z = z + b[0].reshape(-1)[safe]
+        # BCE with target = code: softplus(z) - code * z
+        per = (jax.nn.softplus(z) - code * z) * mask
+        return jnp.sum(per, axis=-1, keepdims=True)
+
+    return apply_op(_f, *args, op_name="hsigmoid_loss")
 
 
 for _n in __all__:

@@ -6,6 +6,7 @@ kernels. TPU-native: lax.reduce_window.
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -17,7 +18,8 @@ __all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
            "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
            "adaptive_avg_pool2d", "adaptive_avg_pool3d",
            "adaptive_max_pool1d", "adaptive_max_pool2d",
-           "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d"]
+           "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d",
+           "max_unpool2d"]
 
 
 def _pool(x, kernel, stride, padding, nd, reducer, init, channels_last,
@@ -40,18 +42,35 @@ def _pool(x, kernel, stride, padding, nd, reducer, init, channels_last,
         strides = (1, 1) + stride
 
     def _f(a):
+        cfg = pad_cfg
+        ceil_extended = False
+        if ceil_mode and not isinstance(cfg, str):
+            # extend high-side padding so the trailing partial window is
+            # kept (reference ceil_mode semantics)
+            cfg = list(cfg)
+            for ax in range(a.ndim):
+                if dims[ax] == 1:
+                    continue
+                lo, hi = cfg[ax]
+                span = a.shape[ax] + lo + hi
+                rem = (span - dims[ax]) % strides[ax]
+                if rem:
+                    cfg[ax] = (lo, hi + strides[ax] - rem)
+                    ceil_extended = True
         if average:
             summed = lax.reduce_window(a, 0.0, lax.add, dims, strides,
-                                       pad_cfg)
-            if count_include_pad or isinstance(pad_cfg, str) or \
-                    all(p == (0, 0) for p in (pad if not isinstance(pad, str) else [])):
+                                       cfg)
+            if not ceil_extended and (
+                    count_include_pad or isinstance(cfg, str)
+                    or all(p == (0, 0) for p in
+                           (pad if not isinstance(pad, str) else []))):
                 denom = float(np.prod(kernel))
                 return summed / denom
             ones = jnp.ones_like(a)
             counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
-                                       pad_cfg)
+                                       cfg)
             return summed / counts
-        return lax.reduce_window(a, init, reducer, dims, strides, pad_cfg)
+        return lax.reduce_window(a, init, reducer, dims, strides, cfg)
     return apply_op(_f, x, op_name=op_name)
 
 
@@ -64,8 +83,96 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool2d_with_index(x, kernel_size, stride, padding,
+                                      data_format == "NHWC", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, lax.max, -jnp.inf,
                  data_format == "NHWC", ceil_mode, op_name="max_pool2d")
+
+
+def _max_pool2d_with_index(x, kernel_size, stride, padding, channels_last,
+                           ceil_mode=False):
+    """max_pool2d(return_mask=True): values + flat argmax index into the
+    input H*W plane (reference: max_pool2d_with_index op), the contract
+    max_unpool2d consumes."""
+    x = _ensure_tensor(x)
+    kh, kw = _tuplize(kernel_size, 2)
+    sh, sw = _tuplize(stride if stride is not None else kernel_size, 2)
+    pad = _tuplize(padding, 2) if not isinstance(padding, (list, tuple)) \
+        else tuple(padding)
+    ph, pw = (pad if len(pad) == 2 else (pad[0], pad[0]))
+
+    def _f(a):
+        if channels_last:
+            a = jnp.moveaxis(a, -1, 1)
+        N, C, H, W = a.shape
+        if ceil_mode:
+            OH = -((H + 2 * ph - kh) // -sh) + 1
+            OW = -((W + 2 * pw - kw) // -sw) + 1
+        else:
+            OH = (H + 2 * ph - kh) // sh + 1
+            OW = (W + 2 * pw - kw) // sw + 1
+        # bottom/right padding may exceed ph/pw under ceil_mode
+        eh = (OH - 1) * sh + kh - H - ph
+        ew = (OW - 1) * sw + kw - W - pw
+        ap = jnp.pad(a, ((0, 0), (0, 0), (ph, max(eh, 0)),
+                         (pw, max(ew, 0))),
+                     constant_values=-jnp.inf)
+        vals, gidx = [], []
+        for dy in range(kh):
+            for dx in range(kw):
+                vals.append(ap[:, :, dy:dy + sh * OH:sh,
+                               dx:dx + sw * OW:sw])
+                yy = jnp.arange(OH) * sh + dy - ph
+                xx = jnp.arange(OW) * sw + dx - pw
+                gidx.append(jnp.broadcast_to(yy[:, None] * W + xx[None, :],
+                                             (N, C, OH, OW)))
+        stack = jnp.stack(vals)
+        am = jnp.argmax(stack, axis=0)
+        out = jnp.max(stack, axis=0)
+        idx = jnp.take_along_axis(jnp.stack(gidx), am[None], axis=0)[0]
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+            idx = jnp.moveaxis(idx, 1, -1)
+        return out, idx.astype(jnp.int32)
+
+    return apply_op(_f, x, op_name="max_pool2d_with_index", n_outs=2)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Scatter pooled values back to the positions recorded by
+    max_pool2d(return_mask=True) (reference: unpool op)."""
+    x = _ensure_tensor(x)
+    indices = _ensure_tensor(indices)
+    kh, kw = _tuplize(kernel_size, 2)
+    sh, sw = _tuplize(stride if stride is not None else kernel_size, 2)
+    pad = _tuplize(padding, 2)
+    ph, pw = pad
+    channels_last = data_format == "NHWC"
+    ih, iw = (x.shape[1:3] if channels_last else x.shape[2:4])
+    if output_size is None:
+        oh = (ih - 1) * sh - 2 * ph + kh
+        ow = (iw - 1) * sw - 2 * pw + kw
+    else:
+        oh, ow = output_size[-2:]
+
+    def _f(a, idx):
+        if channels_last:
+            a = jnp.moveaxis(a, -1, 1)
+            idx = jnp.moveaxis(idx, -1, 1)
+        N, C, H, W = a.shape
+        flat_v = a.reshape(N, C, H * W)
+        flat_i = idx.reshape(N, C, H * W).astype(jnp.int32)
+
+        def scatter(one_v, one_i):
+            return jnp.zeros(oh * ow, one_v.dtype).at[one_i].set(one_v)
+
+        out = jax.vmap(jax.vmap(scatter))(flat_v, flat_i)
+        out = out.reshape(N, C, oh, ow)
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
+
+    return apply_op(_f, x, indices, op_name="max_unpool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,

@@ -419,3 +419,37 @@ for _n in ["std", "var", "median", "quantile", "logsumexp", "cumsum",
            "lerp", "add_n", "multiplex", "trace", "diagonal", "diff",
            "stanh", "nan_to_num", "increment", "count_nonzero"]:
     register(_n, globals()[_n])
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so its L2 norm is at most max_norm (reference:
+    clip_by_norm op — the per-tensor half of gradient clipping)."""
+    x = _ensure_tensor(x)
+    return apply_op(
+        lambda a: a * jnp.minimum(
+            1.0, max_norm / jnp.maximum(
+                jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2)),
+                1e-12)).astype(a.dtype),
+        x, op_name="clip_by_norm")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize each slice along ``axis`` to have p-norm at most
+    max_norm (reference: renorm op)."""
+    x = _ensure_tensor(x)
+
+    def _f(a):
+        a32 = a.astype(jnp.float32)
+        reduce_axes = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a32) ** p, axis=reduce_axes,
+                        keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return (a32 * factor).astype(a.dtype)
+
+    return apply_op(_f, x, op_name="renorm")
+
+
+register("clip_by_norm", clip_by_norm)
+register("renorm", renorm)
+__all__ += ["clip_by_norm", "renorm"]

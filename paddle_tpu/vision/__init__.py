@@ -3,3 +3,4 @@ from . import models
 from . import transforms
 from . import datasets
 from . import ops
+from . import io

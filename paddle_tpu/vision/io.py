@@ -1,0 +1,43 @@
+"""Image IO (reference: python/paddle/vision/image.py + decode_jpeg op,
+which decodes on-GPU via nvjpeg). Host-side decode here (PIL), producing
+the same [C, H, W] uint8 tensor contract."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["read_file", "decode_jpeg", "image_load"]
+
+
+def read_file(filename, name=None) -> Tensor:
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None) -> Tensor:
+    """x: 1-D uint8 tensor of encoded bytes -> [C, H, W] uint8."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("decode_jpeg needs Pillow on the host") from e
+    raw = bytes(np.asarray(x._array if isinstance(x, Tensor) else x,
+                           np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode != "unchanged":
+        img = img.convert(mode.upper() if mode != "gray" else "L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def image_load(path, backend=None):
+    from PIL import Image
+    return Image.open(path)

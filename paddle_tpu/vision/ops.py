@@ -11,7 +11,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply_op
 from ..ops.registry import register, _ensure_tensor
 
-__all__ = ["nms", "box_iou", "roi_align", "deform_conv2d"]
+__all__ = ["nms", "box_iou", "roi_align", "deform_conv2d", "box_coder",
+           "prior_box", "yolo_box", "roi_pool", "psroi_pool", "matrix_nms",
+           "distribute_fpn_proposals", "generate_proposals"]
 
 
 def box_iou(boxes1, boxes2):
@@ -126,3 +128,396 @@ def deform_conv2d(*args, **kwargs):
 
 for _n in ["nms", "box_iou", "roi_align"]:
     register(_n, globals()[_n])
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (reference:
+    operators/detection/box_coder_op). prior_box: [M, 4] (x1,y1,x2,y2);
+    prior_box_var: [M, 4] | [4] | None; encode: target [N, 4] -> [N, M, 4];
+    decode: target [N, M, 4] -> [N, M, 4]."""
+    pb = np.asarray(_ensure_tensor(prior_box)._array, np.float32)
+    tb = np.asarray(_ensure_tensor(target_box)._array, np.float32)
+    pbv = None if prior_box_var is None else \
+        np.asarray(_ensure_tensor(prior_box_var)._array, np.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None]) / pw[None]
+        dy = (tcy[:, None] - pcy[None]) / ph[None]
+        dw = np.log(np.abs(tw[:, None] / pw[None]))
+        dh = np.log(np.abs(th[:, None] / ph[None]))
+        out = np.stack([dx, dy, dw, dh], -1)
+        if pbv is not None:
+            out = out / (pbv[None] if pbv.ndim == 2 else pbv.reshape(1, 1, 4))
+    elif code_type == "decode_center_size":
+        if pbv is None:
+            var = np.ones((1, 1, 4), np.float32)
+        elif pbv.ndim == 1:
+            var = pbv.reshape(1, 1, 4)
+        else:
+            var = pbv[None] if axis == 0 else pbv[:, None]
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = pw[None, :, None], ph[None, :, None], \
+                pcx[None, :, None], pcy[None, :, None]
+        else:
+            pw_, ph_, pcx_, pcy_ = pw[:, None, None], ph[:, None, None], \
+                pcx[:, None, None], pcy[:, None, None]
+        d = tb * var
+        cx = d[..., 0:1] * pw_ + pcx_
+        cy = d[..., 1:2] * ph_ + pcy_
+        w = np.exp(d[..., 2:3]) * pw_
+        h = np.exp(d[..., 3:4]) * ph_
+        out = np.concatenate([cx - w / 2, cy - h / 2,
+                              cx + w / 2 - norm, cy + h / 2 - norm], -1)
+    else:
+        raise ValueError(f"unknown code_type {code_type!r}")
+    return Tensor(jnp.asarray(out))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes (reference: operators/detection/
+    prior_box_op). Returns (boxes [H, W, P, 4], variances same shape)."""
+    feat = _ensure_tensor(input)
+    img = _ensure_tensor(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = []
+        if min_max_aspect_ratios_order:
+            sizes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        boxes.append(sizes)
+    per_cell = [wh for group in boxes for wh in group]
+    P = len(per_cell)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    out = np.zeros((fh, fw, P, 4), np.float32)
+    for p, (bw, bh) in enumerate(per_cell):
+        out[:, :, p, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, p, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, p, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, p, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output [N, P*(5+C), H, W] into boxes + scores
+    (reference: operators/detection/yolo_box_op)."""
+    xa = np.asarray(_ensure_tensor(x)._array, np.float32)
+    imgs = np.asarray(_ensure_tensor(img_size)._array)
+    N, _, H, W = xa.shape
+    P = len(anchors) // 2
+    sig0 = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    ioup = None
+    if iou_aware:
+        # iou-aware head: first P channels are per-anchor IoU logits,
+        # the rest is the standard [P, 5+C] block (reference yolo_box_op)
+        ioup = sig0(xa[:, :P].reshape(N, P, H, W))
+        xa = xa[:, P:]
+    xa = xa.reshape(N, P, 5 + class_num, H, W)
+    grid_x = np.arange(W).reshape(1, 1, 1, W)
+    grid_y = np.arange(H).reshape(1, 1, H, 1)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    bx = (sig(xa[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_x) / W
+    by = (sig(xa[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_y) / H
+    aw = np.asarray(anchors[0::2], np.float32).reshape(1, P, 1, 1)
+    ah = np.asarray(anchors[1::2], np.float32).reshape(1, P, 1, 1)
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    bw = np.exp(xa[:, :, 2]) * aw / in_w
+    bh = np.exp(xa[:, :, 3]) * ah / in_h
+    conf = sig(xa[:, :, 4])
+    if ioup is not None:
+        conf = conf ** (1.0 - iou_aware_factor) * ioup ** iou_aware_factor
+    cls = sig(xa[:, :, 5:])
+    scores = (conf[:, :, None] * cls)
+    ih = imgs[:, 0].astype(np.float32).reshape(N, 1, 1, 1)
+    iw = imgs[:, 1].astype(np.float32).reshape(N, 1, 1, 1)
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = np.clip(x1, 0, iw - 1)
+        y1 = np.clip(y1, 0, ih - 1)
+        x2 = np.clip(x2, 0, iw - 1)
+        y2 = np.clip(y2, 0, ih - 1)
+    boxes = np.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+    scores = np.moveaxis(scores, 2, -1).reshape(N, -1, class_num)
+    keep = conf.reshape(N, -1) >= conf_thresh
+    boxes = boxes * keep[..., None]
+    scores = scores * keep[..., None]
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(scores))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max-pool each ROI into a fixed grid (reference: roi_pool_op)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    feat = np.asarray(_ensure_tensor(x)._array, np.float32)
+    bxs = np.asarray(_ensure_tensor(boxes)._array, np.float32)
+    bn = np.asarray(_ensure_tensor(boxes_num)._array)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    C, H, W = feat.shape[1:]
+    outs = np.zeros((len(bxs), C, oh, ow), np.float32)
+    for r, bx in enumerate(bxs):
+        fmap = feat[batch_idx[r]]
+        x1 = int(round(bx[0] * spatial_scale))
+        y1 = int(round(bx[1] * spatial_scale))
+        x2 = int(round(bx[2] * spatial_scale))
+        y2 = int(round(bx[3] * spatial_scale))
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(oh):
+            ys = y1 + int(np.floor(i * rh / oh))
+            ye = y1 + int(np.ceil((i + 1) * rh / oh))
+            ys, ye = np.clip([ys, ye], 0, H)
+            for j in range(ow):
+                xs = x1 + int(np.floor(j * rw / ow))
+                xe = x1 + int(np.ceil((j + 1) * rw / ow))
+                xs, xe = np.clip([xs, xe], 0, W)
+                if ye > ys and xe > xs:
+                    outs[r, :, i, j] = fmap[:, ys:ye, xs:xe].max((1, 2))
+    return Tensor(jnp.asarray(outs))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive ROI average pooling (reference: psroi_pool_op):
+    input channels C = out_c * oh * ow; bin (i, j) reads its own channel
+    group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    feat = np.asarray(_ensure_tensor(x)._array, np.float32)
+    bxs = np.asarray(_ensure_tensor(boxes)._array, np.float32)
+    bn = np.asarray(_ensure_tensor(boxes_num)._array)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    C, H, W = feat.shape[1:]
+    if C % (oh * ow):
+        raise ValueError(f"channels {C} not divisible by {oh}x{ow}")
+    out_c = C // (oh * ow)
+    outs = np.zeros((len(bxs), out_c, oh, ow), np.float32)
+    for r, bx in enumerate(bxs):
+        fmap = feat[batch_idx[r]]
+        x1 = bx[0] * spatial_scale
+        y1 = bx[1] * spatial_scale
+        rh = max(bx[3] * spatial_scale - y1, 0.1)
+        rw = max(bx[2] * spatial_scale - x1, 0.1)
+        for i in range(oh):
+            ys = int(np.floor(y1 + i * rh / oh))
+            ye = int(np.ceil(y1 + (i + 1) * rh / oh))
+            ys, ye = np.clip([ys, ye], 0, H)
+            for j in range(ow):
+                xs = int(np.floor(x1 + j * rw / ow))
+                xe = int(np.ceil(x1 + (j + 1) * rw / ow))
+                xs, xe = np.clip([xs, xe], 0, W)
+                if ye > ys and xe > xs:
+                    grp = (i * ow + j) * out_c
+                    outs[r, :, i, j] = fmap[grp:grp + out_c,
+                                            ys:ye, xs:xe].mean((1, 2))
+    return Tensor(jnp.asarray(outs))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False, return_rois_num=True,
+               name=None):
+    """Matrix NMS (SOLOv2; reference: operators/detection/matrix_nms_op):
+    parallel soft suppression by decayed IoU instead of greedy removal.
+    bboxes [N, M, 4], scores [N, C, M]."""
+    bb = np.asarray(_ensure_tensor(bboxes)._array, np.float32)
+    sc = np.asarray(_ensure_tensor(scores)._array, np.float32)
+    N, C, M = sc.shape
+    all_out, all_idx, rois_num = [], [], []
+    for n in range(N):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            mask = sc[n, c] > score_threshold
+            idxs = np.nonzero(mask)[0]
+            if len(idxs) == 0:
+                continue
+            s = sc[n, c, idxs]
+            order = np.argsort(-s)
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            idxs, s = idxs[order], s[order]
+            b = bb[n, idxs]
+            norm = 0.0 if normalized else 1.0
+            area = (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+            lt = np.maximum(b[:, None, :2], b[None, :, :2])
+            rb = np.minimum(b[:, None, 2:], b[None, :, 2:])
+            wh = np.clip(rb - lt + norm, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / np.maximum(area[:, None] + area[None] - inter,
+                                     1e-10)
+            iou = np.triu(iou, 1)
+            # compensate IoU: for suppressor i, its own max overlap with
+            # any higher-ranked box (reference matrix_nms_op kernel);
+            # broadcast per ROW (the suppressor), not per column
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               / gaussian_sigma)
+                decay = decay.min(0)
+            else:
+                decay = ((1 - iou)
+                         / np.maximum(1 - iou_cmax[:, None], 1e-10)).min(0)
+            ds = s * decay
+            keep = ds > post_threshold
+            for k in np.nonzero(keep)[0]:
+                dets.append((c, ds[k], b[k], idxs[k]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        out = np.asarray([[d[0], d[1], *d[2]] for d in dets],
+                         np.float32).reshape(-1, 6)
+        all_out.append(out)
+        all_idx.append(np.asarray([d[3] for d in dets], np.int64))
+        rois_num.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(all_out, 0)
+                             if all_out else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.concatenate(all_idx) if all_idx else
+            np.zeros((0,), np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign each ROI to an FPN level by its scale (reference:
+    operators/detection/distribute_fpn_proposals_op). With ``rois_num``
+    (per-image counts for a batched roi list) each level's count output
+    is itself per-image."""
+    rois = np.asarray(_ensure_tensor(fpn_rois)._array, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off)
+                            * (rois[:, 3] - rois[:, 1] + off), 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        rn = np.asarray(_ensure_tensor(rois_num)._array).reshape(-1)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+        n_imgs = len(rn)
+    else:
+        img_of = np.zeros(len(rois), np.int64)
+        n_imgs = 1
+    multi_rois, restore = [], np.zeros(len(rois), np.int64)
+    nums = []
+    cursor = 0
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        # within a level, keep image order (stable: idx is sorted and
+        # rois arrive grouped per image)
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        per_img = np.bincount(img_of[idx], minlength=n_imgs) \
+            .astype(np.int32)
+        nums.append(Tensor(jnp.asarray(per_img)))
+        restore[idx] = np.arange(cursor, cursor + len(idx))
+        cursor += len(idx)
+    return multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation: decode deltas at anchors, clip, filter
+    small, NMS (reference: operators/detection/generate_proposals_v2_op).
+    Single-image oriented; batches loop."""
+    sc = np.asarray(_ensure_tensor(scores)._array, np.float32)
+    bd = np.asarray(_ensure_tensor(bbox_deltas)._array, np.float32)
+    imgs = np.asarray(_ensure_tensor(img_size)._array, np.float32)
+    anc = np.asarray(_ensure_tensor(anchors)._array,
+                     np.float32).reshape(-1, 4)
+    var = np.asarray(_ensure_tensor(variances)._array,
+                     np.float32).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    out_rois, out_num, out_probs = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.clip(v[:, 2] * d[:, 2], None, 10)) * aw
+        h = np.exp(np.clip(v[:, 3] * d[:, 3], None, 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], -1)
+        ih, iw = imgs[n, 0], imgs[n, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        kept = np.asarray(nms(Tensor(jnp.asarray(boxes)),
+                              iou_threshold=nms_thresh,
+                              scores=Tensor(jnp.asarray(s)))._array)
+        kept = kept[:post_nms_top_n]
+        out_rois.append(boxes[kept])
+        out_probs.append(s[kept])
+        out_num.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(out_rois, 0)
+                              if out_rois else np.zeros((0, 4))))
+    probs = Tensor(jnp.asarray(
+        np.concatenate(out_probs, 0).reshape(-1, 1)
+        if out_probs else np.zeros((0, 1), np.float32)))
+    nums = Tensor(jnp.asarray(np.asarray(out_num, np.int32)))
+    if return_rois_num:
+        return rois, probs, nums
+    return rois, probs

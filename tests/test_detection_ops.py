@@ -1,0 +1,260 @@
+"""Detection op suite + pooling-with-index + sequence losses.
+
+Reference analogs: operators/detection/ (box_coder, prior_box, yolo_box,
+roi/psroi pool, matrix_nms, distribute_fpn_proposals,
+generate_proposals_v2), max_pool2d_with_index/unpool ops, warprnnt,
+hsigmoid_loss, edit_distance. Values checked against hand-computed or
+brute-force references.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_box_coder_encode_decode_roundtrip():
+    prior = T(np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32))
+    var = T(np.array([0.1, 0.1, 0.2, 0.2], np.float32))
+    target = T(np.array([[2, 2, 8, 8]], np.float32))
+    enc = vops.box_coder(prior, var, target, "encode_center_size")
+    assert list(enc.shape) == [1, 2, 4]
+    dec = vops.box_coder(prior, var, T(enc.numpy()),
+                         "decode_center_size")
+    np.testing.assert_allclose(dec.numpy()[0, 0], [2, 2, 8, 8],
+                               atol=1e-4)
+
+
+def test_prior_box_shapes_and_range():
+    feat = T(np.zeros((1, 8, 4, 4), np.float32))
+    img = T(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = vops.prior_box(feat, img, min_sizes=[8.0],
+                                aspect_ratios=[2.0], clip=True)
+    assert list(boxes.shape) == [4, 4, 2, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    assert var.numpy().shape == b.shape
+
+
+def test_yolo_box_decodes_center_cell():
+    # one anchor, one class, 1x1 grid: zero logits put the box center at
+    # the cell center scaled by the image
+    x = np.zeros((1, 6, 1, 1), np.float32)
+    boxes, scores = vops.yolo_box(T(x), T(np.array([[32, 32]], np.int32)),
+                                  anchors=[16, 16], class_num=1,
+                                  conf_thresh=0.0, downsample_ratio=32)
+    b = boxes.numpy()[0, 0]
+    # sigmoid(0)=0.5 -> center (0.5, 0.5) * 32 = 16; w=h=16 -> [8,8,24,24]
+    np.testing.assert_allclose(b, [8, 8, 24, 24], atol=1e-3)
+    assert scores.numpy().shape == (1, 1, 1)
+
+
+def test_roi_pool_and_psroi_pool():
+    feat = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    boxes = T(np.array([[0, 0, 3, 3]], np.float32))
+    bn = T(np.array([1], np.int32))
+    out = vops.roi_pool(T(feat), boxes, bn, output_size=2)
+    assert out.shape[-2:] == [2, 2] or tuple(out.shape[-2:]) == (2, 2)
+    # max of the 2x2 sub-bins of feat[0:4, 0:4]
+    np.testing.assert_allclose(out.numpy()[0, 0],
+                               [[9, 11], [25, 27]])
+    feat4 = np.tile(feat, (1, 4, 1, 1))
+    ps = vops.psroi_pool(T(feat4), boxes, bn, output_size=2)
+    assert ps.numpy().shape == (1, 1, 2, 2)
+
+
+def test_matrix_nms_suppresses_duplicates():
+    # partial overlap (IoU ~0.68): linear decay must use the SUPPRESSOR's
+    # compensate IoU (the r-review broadcast bug class), giving
+    # decay = (1-iou)/(1-0) ~ 0.32 -> 0.8 * 0.32 < 0.5 post threshold
+    boxes = np.array([[[0, 0, 10, 10], [0, 2, 10, 12],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # one class
+    out, idx, num = vops.matrix_nms(T(boxes), T(scores),
+                                    score_threshold=0.1,
+                                    post_threshold=0.5,
+                                    background_label=-1,
+                                    return_index=True)
+    o = out.numpy()
+    assert int(num.numpy()[0]) == 2  # overlapping box decayed below 0.5
+    np.testing.assert_allclose(sorted(o[:, 1], reverse=True), o[:, 1])
+    np.testing.assert_allclose(sorted(o[:, 1]), [0.7, 0.9])
+
+
+def test_distribute_fpn_proposals_assigns_levels():
+    rois = T(np.array([[0, 0, 10, 10],       # small -> low level
+                       [0, 0, 200, 200]], np.float32))  # big -> high
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        rois, min_level=2, max_level=5, refer_level=4, refer_scale=224)
+    sizes = [len(m.numpy()) for m in multi]
+    # scale 10 -> clipped to level 2; scale 200 -> floor(log2(200/224))+4 = 3
+    assert sizes == [1, 1, 0, 0]
+    assert sorted(restore.numpy().reshape(-1).tolist()) == [0, 1]
+    assert [int(x.numpy()[0]) for x in nums] == sizes
+
+
+def test_generate_proposals_end_to_end():
+    rng = np.random.default_rng(0)
+    scores = rng.random((1, 3, 4, 4)).astype(np.float32)
+    deltas = (rng.standard_normal((1, 12, 4, 4)) * 0.1).astype(np.float32)
+    anchors = rng.random((4, 4, 3, 4)).astype(np.float32) * 16
+    anchors[..., 2:] += 16
+    var = np.full((4, 4, 3, 4), 1.0, np.float32)
+    rois, probs, nums = vops.generate_proposals(
+        T(scores), T(deltas), T(np.array([[32, 32]], np.float32)),
+        T(anchors), T(var), pre_nms_top_n=20, post_nms_top_n=5,
+        nms_thresh=0.7, min_size=1.0, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and len(r) == int(nums.numpy()[0]) <= 5
+    p = probs.numpy()
+    assert p.shape == (len(r), 1)
+    assert (np.diff(p[:, 0]) <= 1e-6).all()  # kept scores stay ranked
+    assert (r[:, 0] <= r[:, 2]).all() and (r[:, 1] <= r[:, 3]).all()
+    assert (r >= 0).all() and (r <= 32).all()
+
+
+def test_max_pool_mask_unpool_roundtrip():
+    x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+    out, mask = F.max_pool2d(T(x), 2, return_mask=True)
+    np.testing.assert_allclose(out.numpy()[0, 0], [[5, 7], [13, 15]])
+    rec = F.max_unpool2d(out, mask, 2)
+    r = rec.numpy()
+    assert r.shape == (1, 2, 4, 4)
+    assert r[0, 0, 1, 1] == 5.0 and r[0, 0, 0, 0] == 0.0
+    assert r.sum() == out.numpy().sum()
+
+
+def test_rnnt_loss_matches_bruteforce_dp():
+    B, Tt, U, V = 2, 4, 3, 5
+    rng = np.random.default_rng(1)
+    logits = rng.standard_normal((B, Tt, U + 1, V)).astype(np.float32)
+    labels = rng.integers(1, V, (B, U)).astype(np.int64)
+    lp = np.asarray(jax.nn.log_softmax(logits, -1))
+    want = []
+    for b in range(B):
+        alpha = np.full((Tt, U + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(Tt):
+            for u in range(U + 1):
+                if t == 0 and u == 0:
+                    continue
+                c = []
+                if t > 0:
+                    c.append(alpha[t - 1, u] + lp[b, t - 1, u, 0])
+                if u > 0:
+                    c.append(alpha[t, u - 1]
+                             + lp[b, t, u - 1, labels[b, u - 1]])
+                alpha[t, u] = np.logaddexp.reduce(c)
+        want.append(-(alpha[Tt - 1, U] + lp[b, Tt - 1, U, 0]))
+    got = F.rnnt_loss(T(logits), T(labels),
+                      T(np.full(B, Tt, np.int64)),
+                      T(np.full(B, U, np.int64)),
+                      reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_hsigmoid_custom_path_matches_manual():
+    x = np.array([[1.0, -1.0]], np.float32)
+    w = np.array([[0.5, 0.5], [1.0, 0.0]], np.float32)
+    tbl = np.array([[0, 1]], np.int64)
+    code = np.array([[1.0, 0.0]], np.float32)
+    loss = F.hsigmoid_loss(T(x), T(np.array([0], np.int64)), 3, T(w),
+                           path_table=T(tbl), path_code=T(code))
+    z = np.array([0.0, 1.0])  # w @ x
+    want = np.sum(np.logaddexp(0, z) - code[0] * z)
+    np.testing.assert_allclose(loss.numpy()[0, 0], want, rtol=1e-5)
+
+
+def test_edit_distance_known_cases():
+    d, n = F.edit_distance(T(np.array([[1, 2, 3, 0]], np.int64)),
+                           T(np.array([[1, 3, 3, 0]], np.int64)),
+                           normalized=False,
+                           input_length=T(np.array([3])),
+                           label_length=T(np.array([3])))
+    assert d.numpy()[0, 0] == 1.0 and n.numpy()[0] == 1
+    d2, _ = F.edit_distance(T(np.array([[1, 2]], np.int64)),
+                            T(np.array([[3, 4]], np.int64)))
+    assert d2.numpy()[0, 0] == 1.0  # normalized: 2 edits / len 2
+
+
+def test_vision_io_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+    arr = np.random.default_rng(0).integers(0, 255, (8, 8, 3)) \
+        .astype(np.uint8)
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(arr).save(p)
+    dec = paddle.vision.io.decode_jpeg(paddle.vision.io.read_file(p))
+    assert tuple(dec.shape) == (3, 8, 8)
+    gray = paddle.vision.io.decode_jpeg(paddle.vision.io.read_file(p),
+                                        mode="gray")
+    assert tuple(gray.shape) == (1, 8, 8)
+
+
+def test_max_pool_mask_respects_ceil_mode():
+    x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    plain = F.max_pool2d(T(x), 2, stride=2, ceil_mode=True)
+    out, mask = F.max_pool2d(T(x), 2, stride=2, ceil_mode=True,
+                             return_mask=True)
+    assert out.numpy().shape == plain.numpy().shape == (1, 1, 3, 3)
+    np.testing.assert_allclose(out.numpy(), plain.numpy())
+    assert mask.numpy()[0, 0, 2, 2] == 24  # corner survives ceil padding
+
+
+def test_yolo_box_iou_aware_layout():
+    # P=1, C=1, iou_aware: channels = P*(6+C) = 7
+    x = np.zeros((1, 7, 1, 1), np.float32)
+    x[:, 0] = 4.0  # iou logit -> sigmoid ~ 0.982
+    boxes, scores = vops.yolo_box(
+        T(x), T(np.array([[32, 32]], np.int32)), anchors=[16, 16],
+        class_num=1, conf_thresh=0.0, downsample_ratio=32,
+        iou_aware=True, iou_aware_factor=0.5)
+    # conf = sigmoid(0)^0.5 * sigmoid(4)^0.5; score = conf * sigmoid(0)
+    want = (0.5 ** 0.5) * (1 / (1 + np.exp(-4.0))) ** 0.5 * 0.5
+    np.testing.assert_allclose(scores.numpy()[0, 0, 0], want, rtol=1e-4)
+
+
+def test_rnnt_fastemit_scales_label_grads_only():
+    B, Tt, U, V = 1, 3, 2, 4
+    rng = np.random.default_rng(2)
+    logits = rng.standard_normal((B, Tt, U + 1, V)).astype(np.float32)
+    labels = np.array([[1, 2]], np.int64)
+
+    def loss_at(lam):
+        lt = T(logits)
+        lt.stop_gradient = False
+        out = F.rnnt_loss(lt, T(labels), T(np.array([Tt])),
+                          T(np.array([U])), fastemit_lambda=lam,
+                          reduction="sum")
+        out.backward()
+        return float(out.numpy()), np.asarray(lt.grad._array)
+
+    v0, g0 = loss_at(0.0)
+    v1, g1 = loss_at(0.5)
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)  # value unchanged
+    assert not np.allclose(g0, g1)                 # grads differ
+
+
+def test_hsigmoid_accepts_2d_bias():
+    rng = np.random.default_rng(3)
+    x = T(rng.standard_normal((2, 4)).astype(np.float32))
+    w = T(rng.standard_normal((7, 4)).astype(np.float32))
+    b = T(rng.standard_normal((7, 1)).astype(np.float32))
+    out = F.hsigmoid_loss(x, T(np.array([0, 5], np.int64)), 8, w, bias=b)
+    assert out.numpy().shape == (2, 1)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_distribute_fpn_proposals_per_image_counts():
+    rois = T(np.array([[0, 0, 10, 10], [0, 0, 200, 200],
+                       [0, 0, 12, 12]], np.float32))
+    multi, restore, nums = vops.distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, rois_num=T(np.array([2, 1], np.int32)))
+    # level 2 gets rois 0 (img 0) and 2 (img 1); level 3 gets roi 1 (img 0)
+    assert nums[0].numpy().tolist() == [1, 1]
+    assert nums[1].numpy().tolist() == [1, 0]
