@@ -11,18 +11,31 @@ per-row logsumexp, and a true flash backward (dq kernel + dk/dv kernel)
 that recomputes attention probabilities block-wise from the saved LSE —
 no O(S^2) materialization in either direction.
 
+Two kernel variants, auto-selected by sequence length (_use_resident):
+
+- "resident" (short S): the non-grid sequence operands (k/v for fwd/dq,
+  q/g/o/lse for dkv) live whole in VMEM and an in-kernel fori_loop walks
+  them, skipping fully-masked causal blocks outright. Fastest, but VMEM
+  residency grows with S — stops compiling around S=8192 on 16MB parts.
+- "streamed" (long S): BOTH sequence dimensions ride grid axes — grid
+  (BH, S/bq, S/bk) with the contraction axis innermost — carrying
+  running statistics (m/l/acc for the forward's online softmax; dq/dk/dv
+  partials for the backwards) in VMEM scratch initialized when the
+  innermost index is 0 and flushed to the revisited output block on the
+  last step. VMEM is a function of BLOCK sizes only: S=8k/32k compile
+  with the same footprint as S=2k. Masked causal blocks are predicated
+  out (@pl.when) rather than skipped, which is the price of the
+  streaming (~30% at S=2k — why the resident variant is kept).
+
 TPU layout notes (Mosaic tiling):
 - Every HBM<->VMEM block must have its last dim divisible by 128 (or equal
   to the array dim) and its second-to-last divisible by 8 (or equal) —
   see ``mosaic_block_legal`` below, which mirrors the rule in
   jax/_src/pallas/mosaic/lowering.py::_check_block_mappings and is unit
   tested against every BlockSpec this module creates.
-- Per-row statistics (LSE) therefore travel as [.., S, 128] tiles with the
-  scalar replicated across the 128 lanes — the same layout jax's reference
-  TPU flash attention uses — never as a bare [.., S] vector, whose (1, bq)
-  block is Mosaic-illegal. The delta term (rowsum(g*o)) is computed inside
-  the backward kernels from the g/o blocks, so it needs no HBM layout at
-  all.
+- Per-row statistics (LSE) travel as [.., S, 128] tiles with the scalar
+  replicated across the 128 lanes — never as a bare [.., S] vector,
+  whose (1, bq) block is Mosaic-illegal.
 
 Set ``_INTERPRET = True`` (tests do) to run the kernels through the Pallas
 interpreter on CPU for numerical validation without TPU hardware.
@@ -68,34 +81,6 @@ def _on_tpu():
         return False
 
 
-def flash_attention_available(q_shape, dtype=None):
-    if _DISABLE:
-        return False
-    B, S, H, D = q_shape
-    bq, bk = _block_config(S, D, dtype)
-    shapes_ok = D % 128 == 0 and S % bq == 0 and S % bk == 0 and S >= bq
-    return shapes_ok and (_on_tpu() or _INTERPRET)
-
-
-def mosaic_block_legal(block_shape, array_shape, dtype_bits=32):
-    """Pure-shape mirror of Mosaic's _check_block_mappings rule.
-
-    rank >= 2: last block dim divisible by 128 or equal to the array dim,
-    second-to-last divisible by 8 or equal. rank 1: divisible by
-    128 * (32 // dtype_bits) or equal.
-    """
-    bs = tuple(int(d) for d in block_shape)
-    ashape = tuple(int(d) for d in array_shape)
-    if len(bs) != len(ashape) or len(bs) < 1:
-        return False
-    if len(bs) >= 2:
-        ok_last = bs[-1] == ashape[-1] or bs[-1] % 128 == 0
-        ok_sub = bs[-2] == ashape[-2] or bs[-2] % 8 == 0
-        return ok_last and ok_sub
-    tiling = 128 * (32 // dtype_bits)
-    return bs[0] == ashape[0] or bs[0] % tiling == 0
-
-
 def _blocks_legal(bq, bk, S, D):
     """A cached/tuned (bq, bk) is usable iff it tiles S and every derived
     HBM BlockSpec is Mosaic-legal, plus the kernel-internal constraint
@@ -127,18 +112,71 @@ def _block_config(S, D, dtype=None):
     return _BQ, _BK
 
 
-def flash_block_specs(BH, S, D, bq=_BQ, bk=_BK):
+def flash_attention_available(q_shape, dtype=None):
+    if _DISABLE:
+        return False
+    B, S, H, D = q_shape
+    bq, bk = _block_config(S, D, dtype)
+    shapes_ok = D % 128 == 0 and S % bq == 0 and S % bk == 0 and S >= bq
+    return shapes_ok and (_on_tpu() or _INTERPRET)
+
+
+def mosaic_block_legal(block_shape, array_shape, dtype_bits=32):
+    """Pure-shape mirror of Mosaic's _check_block_mappings rule.
+
+    rank >= 2: last block dim divisible by 128 or equal to the array dim,
+    second-to-last divisible by 8 or equal. rank 1: divisible by
+    128 * (32 // dtype_bits) or equal.
+    """
+    bs = tuple(int(d) for d in block_shape)
+    ashape = tuple(int(d) for d in array_shape)
+    if len(bs) != len(ashape) or len(bs) < 1:
+        return False
+    if len(bs) >= 2:
+        ok_last = bs[-1] == ashape[-1] or bs[-1] % 128 == 0
+        ok_sub = bs[-2] == ashape[-2] or bs[-2] % 8 == 0
+        return ok_last and ok_sub
+    tiling = 128 * (32 // dtype_bits)
+    return bs[0] == ashape[0] or bs[0] % tiling == 0
+
+
+# Above this many bytes of whole-sequence VMEM residency (the bwd_dkv
+# kernel's q/g/o [S, D] + lse [S, 128] f32 working set), the loop-based
+# "resident" kernels stop compiling on 16MB-VMEM parts; the streamed
+# variant (grid-blocked everything + scratch accumulators) takes over.
+# Resident is ~30% faster at short S (its in-kernel loop skips masked
+# causal blocks entirely; the streamed grid only predicates them out).
+_RESIDENT_MAX_BYTES = 6 * 2 ** 20
+
+
+def _use_resident(S, D, itemsize=2):
+    return 3 * S * D * itemsize + S * _LANES * 4 <= _RESIDENT_MAX_BYTES
+
+
+def flash_block_specs(BH, S, D, bq=_BQ, bk=_BK, resident=None):
     """(block_shape, array_shape) for every HBM operand of the three flash
     kernels — the single source the pallas_calls below and the shape unit
-    test both consume."""
+    test both consume. Two variants (auto-selected by S): "resident"
+    keeps k/v (fwd, dq) and q/g/o/lse (dkv) whole in VMEM and loops
+    in-kernel; "streamed" blocks every operand on the grid."""
+    if resident is None:
+        resident = _use_resident(S, D)
     qblk = ((1, bq, D), (BH, S, D))
     kblk = ((1, bk, D), (BH, S, D))
+    lse_q = ((1, bq, _LANES), (BH, S, _LANES))
+    if not resident:
+        return {
+            "fwd": {"in": [qblk, kblk, kblk], "out": [qblk, lse_q]},
+            "bwd_dq": {"in": [qblk, kblk, kblk, qblk, qblk, lse_q],
+                       "out": [qblk]},
+            "bwd_dkv": {"in": [qblk, kblk, kblk, qblk, qblk, lse_q],
+                        "out": [kblk, kblk]},
+        }
     full = ((1, S, D), (BH, S, D))
-    lse_blk = ((1, bq, _LANES), (BH, S, _LANES))
     lse_full = ((1, S, _LANES), (BH, S, _LANES))
     return {
-        "fwd": {"in": [qblk, full, full], "out": [qblk, lse_blk]},
-        "bwd_dq": {"in": [qblk, full, full, qblk, qblk, lse_blk],
+        "fwd": {"in": [qblk, full, full], "out": [qblk, lse_q]},
+        "bwd_dq": {"in": [qblk, full, full, qblk, qblk, lse_q],
                    "out": [qblk]},
         "bwd_dkv": {"in": [full, kblk, kblk, full, full, lse_full],
                     "out": [kblk, kblk]},
@@ -171,11 +209,247 @@ def _rep_lanes(col, n_lanes):
     return t if reps == 1 else jnp.tile(t, (1, reps))
 
 
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
 # ---------------------------------------------------------------------------
 # Pallas flash forward (emits LSE for the backward)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale):
+def _flash_fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_s, l_s, acc_s, *, bq, bk, scale):
+    from jax.experimental import pallas as pl
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, -jnp.inf)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # causal: the block contributes iff its first key position is within
+    # this q block's band
+    @pl.when(ki * bk < (qi + 1) * bq)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)           # [bq, D]
+        D = q.shape[-1]
+        k = k_ref[0].astype(jnp.float32)           # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m = m_s[...]
+        l = l_s[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1)[:, None])  # [bq, 128]
+        p = jnp.exp(s - _rep_lanes(m_new[:, :1], bk))
+        corr = jnp.exp(m - m_new)
+        l_s[...] = l * corr + jnp.sum(p, axis=-1)[:, None]
+        m_s[...] = m_new
+        acc_s[...] = acc_s[...] * _rep_lanes(corr[:, :1], D) + lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _flush():
+        D = acc_s.shape[-1]
+        l = l_s[...]
+        o_ref[0] = (acc_s[...] / _rep_lanes(l[:, :1], D)).astype(
+            o_ref.dtype)
+        lse_ref[0] = m_s[...] + jnp.log(l)
+
+
+def _flash_fwd_streamed(q, k, v, bq=None, bk=None):
+    """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S,128] fp32, value
+    replicated across the trailing lane dim)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    BH, S, D = q.shape
+    if bq is None or bk is None:
+        bq, bk = _block_config(S, D, q.dtype)
+    scale = 1.0 / math.sqrt(D)
+    specs = flash_block_specs(BH, S, D, bq, bk, resident=False)["fwd"]
+    grid = (BH, S // bq, S // bk)
+    by_q = lambda b, i, j: (b, i, 0)  # noqa: E731
+    by_k = lambda b, i, j: (b, j, 0)  # noqa: E731
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel_streamed, bq=bq, bk=bk, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(specs["in"][0][0], by_q),
+            pl.BlockSpec(specs["in"][1][0], by_k),
+            pl.BlockSpec(specs["in"][2][0], by_k),
+        ],
+        out_specs=(pl.BlockSpec(specs["out"][0][0], by_q),
+                   pl.BlockSpec(specs["out"][1][0], by_q)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((bq, _LANES), jnp.float32),   # running sum
+            pltpu.VMEM((bq, D), jnp.float32),        # output accumulator
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash backward: dq kernel (streams k blocks on the grid)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel_streamed(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+                         dq_ref, dq_s, *, bq, bk, scale):
+    from jax.experimental import pallas as pl
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    @pl.when(ki * bk < (qi + 1) * bq)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)            # [bq, D]
+        g = g_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                            # [bq, 128]
+        delta = jnp.sum(g * o, axis=-1)[:, None]    # [bq, 1]
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        p = jnp.where(q_pos >= k_pos,
+                      jnp.exp(s - _rep_lanes(lse[:, :1], bk)), 0.0)
+        dp = lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - _rep_lanes(delta, bk))
+        dq_s[...] = dq_s[...] + lax.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _flush():
+        dq_ref[0] = (dq_s[...] * scale).astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash backward: dk/dv kernel (streams q blocks on the grid)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dkv_kernel_streamed(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+                          dk_ref, dv_ref, dk_s, dv_s, *, bq, bk, scale):
+    from jax.experimental import pallas as pl
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_qb = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    # causal: q blocks strictly before this k block are fully masked
+    @pl.when((qi + 1) * bq > ki * bk)
+    def _update():
+        k = k_ref[0].astype(jnp.float32)            # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)            # [bq, D]
+        g = g_ref[0].astype(jnp.float32)
+        o = o_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                            # [bq, 128]
+        delta = jnp.sum(g * o, axis=-1)[:, None]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        p = jnp.where(q_pos >= k_pos,
+                      jnp.exp(s - _rep_lanes(lse[:, :1], bk)), 0.0)
+        dv_s[...] = dv_s[...] + lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - _rep_lanes(delta, bk))
+        dk_s[...] = dk_s[...] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _flush():
+        dk_ref[0] = (dk_s[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_streamed(q, k, v, g, o, lse, bq=None, bk=None):
+    """q,k,v,g,o: [BH, S, D]; lse: [BH, S, 128]; returns dq, dk, dv."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    BH, S, D = q.shape
+    if bq is None or bk is None:
+        bq, bk = _block_config(S, D, q.dtype)
+    scale = 1.0 / math.sqrt(D)
+    specs = flash_block_specs(BH, S, D, bq, bk, resident=False)
+
+    by_q = lambda b, i, j: (b, i, 0)    # noqa: E731
+    by_k = lambda b, i, j: (b, j, 0)    # noqa: E731
+
+    dq_specs = specs["bwd_dq"]
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel_streamed, bq=bq, bk=bk, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        grid=(BH, S // bq, S // bk),
+        in_specs=[
+            pl.BlockSpec(dq_specs["in"][0][0], by_q),   # q
+            pl.BlockSpec(dq_specs["in"][1][0], by_k),   # k
+            pl.BlockSpec(dq_specs["in"][2][0], by_k),   # v
+            pl.BlockSpec(dq_specs["in"][3][0], by_q),   # g
+            pl.BlockSpec(dq_specs["in"][4][0], by_q),   # o
+            pl.BlockSpec(dq_specs["in"][5][0], by_q),   # lse
+        ],
+        out_specs=pl.BlockSpec(dq_specs["out"][0][0], by_q),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=_INTERPRET,
+    )(q, k, v, g, o, lse)
+
+    # dkv grid: k blocks ride dim 1 (the by_q map), q blocks stream on
+    # dim 2 (the by_k map) — same two index maps, roles swapped
+    by_kv, by_qs = by_q, by_k
+    dkv_specs = specs["bwd_dkv"]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel_streamed, bq=bq, bk=bk,
+                          scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)),
+        grid=(BH, S // bk, S // bq),
+        in_specs=[
+            pl.BlockSpec(dkv_specs["in"][0][0], by_qs),   # q
+            pl.BlockSpec(dkv_specs["in"][1][0], by_kv),   # k
+            pl.BlockSpec(dkv_specs["in"][2][0], by_kv),   # v
+            pl.BlockSpec(dkv_specs["in"][3][0], by_qs),   # g
+            pl.BlockSpec(dkv_specs["in"][4][0], by_qs),   # o
+            pl.BlockSpec(dkv_specs["in"][5][0], by_qs),   # lse
+        ],
+        out_specs=(pl.BlockSpec(dkv_specs["out"][0][0], by_kv),
+                   pl.BlockSpec(dkv_specs["out"][1][0], by_kv)),
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=_INTERPRET,
+    )(q, k, v, g, o, lse)
+    return dq, dk, dv
+
+
+def _flash_fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # [bq, D]
@@ -208,7 +482,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale):
     lse_ref[0] = m + jnp.log(l)                                # [bq, 128]
 
 
-def _flash_fwd(q, k, v, bq=None, bk=None):
+def _flash_fwd_resident(q, k, v, bq=None, bk=None):
     """q,k,v: [BH, S, D] → (out [BH,S,D], lse [BH,S,128] fp32, value
     replicated across the trailing lane dim)."""
     from jax.experimental import pallas as pl
@@ -216,12 +490,12 @@ def _flash_fwd(q, k, v, bq=None, bk=None):
     if bq is None or bk is None:
         bq, bk = _block_config(S, D, q.dtype)
     scale = 1.0 / math.sqrt(D)
-    specs = flash_block_specs(BH, S, D, bq, bk)["fwd"]
+    specs = flash_block_specs(BH, S, D, bq, bk, resident=True)["fwd"]
     grid = (BH, S // bq)
     blocked = lambda b, i: (b, i, 0)  # noqa: E731
     whole = lambda b, i: (b, 0, 0)    # noqa: E731
     out, lse = pl.pallas_call(
-        functools.partial(_flash_fwd_kernel, bq=bq, bk=bk, scale=scale),
+        functools.partial(_flash_fwd_kernel_resident, bq=bq, bk=bk, scale=scale),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), q.dtype),
                    jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32)),
         grid=grid,
@@ -241,7 +515,7 @@ def _flash_fwd(q, k, v, bq=None, bk=None):
 # Pallas flash backward: dq kernel (loops over k blocks)
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+def _flash_bwd_dq_kernel_resident(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
                          dq_ref, *, bq, bk, scale):
     from jax.experimental import pallas as pl
     qi = pl.program_id(1)
@@ -277,7 +551,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
 # Pallas flash backward: dk/dv kernel (loops over q blocks)
 # ---------------------------------------------------------------------------
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+def _flash_bwd_dkv_kernel_resident(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
                           dk_ref, dv_ref, *, bq, bk, scale, n_qblocks):
     from jax.experimental import pallas as pl
     ki = pl.program_id(1)
@@ -316,21 +590,21 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, g, o, lse, bq=None, bk=None):
+def _flash_bwd_resident(q, k, v, g, o, lse, bq=None, bk=None):
     """q,k,v,g,o: [BH, S, D]; lse: [BH, S, 128]; returns dq, dk, dv."""
     from jax.experimental import pallas as pl
     BH, S, D = q.shape
     if bq is None or bk is None:
         bq, bk = _block_config(S, D, q.dtype)
     scale = 1.0 / math.sqrt(D)
-    specs = flash_block_specs(BH, S, D, bq, bk)
+    specs = flash_block_specs(BH, S, D, bq, bk, resident=True)
 
     blocked = lambda b, i: (b, i, 0)  # noqa: E731
     whole = lambda b, i: (b, 0, 0)    # noqa: E731
 
     dq_specs = specs["bwd_dq"]
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, bq=bq, bk=bk, scale=scale),
+        functools.partial(_flash_bwd_dq_kernel_resident, bq=bq, bk=bk, scale=scale),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         grid=(BH, S // bq),
         in_specs=[
@@ -347,7 +621,7 @@ def _flash_bwd(q, k, v, g, o, lse, bq=None, bk=None):
 
     dkv_specs = specs["bwd_dkv"]
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale,
+        functools.partial(_flash_bwd_dkv_kernel_resident, bq=bq, bk=bk, scale=scale,
                           n_qblocks=S // bq),
         out_shape=(jax.ShapeDtypeStruct((BH, S, D), k.dtype),
                    jax.ShapeDtypeStruct((BH, S, D), v.dtype)),
@@ -365,6 +639,24 @@ def _flash_bwd(q, k, v, g, o, lse, bq=None, bk=None):
         interpret=_INTERPRET,
     )(q, k, v, g, o, lse)
     return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# variant dispatch
+# ---------------------------------------------------------------------------
+
+def _flash_fwd(q, k, v, bq=None, bk=None):
+    BH, S, D = q.shape
+    if _use_resident(S, D, jnp.dtype(q.dtype).itemsize):
+        return _flash_fwd_resident(q, k, v, bq, bk)
+    return _flash_fwd_streamed(q, k, v, bq, bk)
+
+
+def _flash_bwd(q, k, v, g, o, lse, bq=None, bk=None):
+    BH, S, D = q.shape
+    if _use_resident(S, D, jnp.dtype(q.dtype).itemsize):
+        return _flash_bwd_resident(q, k, v, g, o, lse, bq, bk)
+    return _flash_bwd_streamed(q, k, v, g, o, lse, bq, bk)
 
 
 # ---------------------------------------------------------------------------
