@@ -1,7 +1,8 @@
-"""Vision ops (reference: python/paddle/vision/ops.py + operators/detection).
-
-Round-1 subset: nms, box conversion, roi_align (vectorized bilinear), yolo
-boxes deferred.
+"""Vision ops (reference: python/paddle/vision/ops.py + operators/detection):
+nms/matrix_nms, box_iou/box_coder, prior_box, yolo_box, roi_align/roi_pool/
+psroi_pool, distribute_fpn_proposals, generate_proposals, deform_conv2d
+(+DeformConv2D layer). Detection ops with dynamic output sizes run host-side
+(like the reference CPU kernels); dense/differentiable ops are jnp.
 """
 from __future__ import annotations
 
@@ -13,7 +14,8 @@ from ..ops.registry import register, _ensure_tensor
 
 __all__ = ["nms", "box_iou", "roi_align", "deform_conv2d", "box_coder",
            "prior_box", "yolo_box", "roi_pool", "psroi_pool", "matrix_nms",
-           "distribute_fpn_proposals", "generate_proposals"]
+           "distribute_fpn_proposals", "generate_proposals",
+           "DeformConv2D"]
 
 
 def box_iou(boxes1, boxes2):
@@ -121,9 +123,97 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return apply_op(_f, x, boxes, op_name="roi_align")
 
 
-def deform_conv2d(*args, **kwargs):
-    raise NotImplementedError(
-        "deform_conv2d: planned (needs a gather-based Pallas kernel)")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference:
+    operators/deformable_conv_op + python/paddle/vision/ops.py). Sampling
+    positions are the regular conv grid displaced by learned per-position
+    offsets; v2 additionally modulates samples by ``mask``. Gather-based
+    bilinear sampling in jnp — differentiable through offsets, mask, x,
+    and weight.
+
+    x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo] with (dy, dx)
+    channel pairs; weight: [Cout, Cin//groups, kh, kw];
+    mask: [N, dg*kh*kw, Ho, Wo] or None.
+    """
+    x = _ensure_tensor(x)
+    offset = _ensure_tensor(offset)
+    weight = _ensure_tensor(weight)
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    ph, pw = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    Cout, Cin_g, kh, kw = weight.shape
+    args = [x, offset, weight]
+    has_mask = mask is not None
+    if has_mask:
+        args.append(_ensure_tensor(mask))
+    if bias is not None:
+        args.append(_ensure_tensor(bias))
+
+    def _f(xa, off, w, *rest):
+        m = rest[0] if has_mask else None
+        b = rest[-1] if bias is not None else None
+        N, Cin, H, W = xa.shape
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        cpg = Cin // dg    # channels per deform group
+        base_i = jnp.arange(Ho) * sh - ph
+        base_j = jnp.arange(Wo) * sw - pw
+        xf = xa.astype(jnp.float32)
+        cols = []  # per (r, s): [N, Cin, Ho, Wo]
+        for r in range(kh):
+            for s in range(kw):
+                kidx = r * kw + s
+                per_g = []
+                for g_ in range(dg):
+                    dy = off[:, 2 * (g_ * kh * kw + kidx)]
+                    dx = off[:, 2 * (g_ * kh * kw + kidx) + 1]
+                    py = base_i[None, :, None] + r * dh \
+                        + dy.astype(jnp.float32)
+                    px = base_j[None, None, :] + s * dw \
+                        + dx.astype(jnp.float32)
+                    y0 = jnp.floor(py)
+                    x0 = jnp.floor(px)
+                    wy = py - y0
+                    wx = px - x0
+                    pieces = 0.0
+                    for (yy, cy) in ((y0, 1 - wy), (y0 + 1, wy)):
+                        for (xx, cx) in ((x0, 1 - wx), (x0 + 1, wx)):
+                            inb = ((yy >= 0) & (yy <= H - 1)
+                                   & (xx >= 0) & (xx <= W - 1))
+                            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                            ch = xf[:, g_ * cpg:(g_ + 1) * cpg]
+                            # flat gather: position index varies with BOTH
+                            # output coords, so index the H*W plane
+                            lin = (yi * W + xi).reshape(N, 1, Ho * Wo)
+                            v = jnp.take_along_axis(
+                                ch.reshape(N, cpg, H * W),
+                                jnp.broadcast_to(lin, (N, cpg, Ho * Wo)),
+                                axis=2).reshape(N, cpg, Ho, Wo)
+                            coef = (cy * cx
+                                    * inb.astype(jnp.float32))[:, None]
+                            pieces = pieces + v * coef
+                    if m is not None:
+                        pieces = pieces * m[:, g_ * kh * kw + kidx,
+                                            None].astype(jnp.float32)
+                    per_g.append(pieces)
+                cols.append(jnp.concatenate(per_g, axis=1))
+        col = jnp.stack(cols, axis=2)  # [N, Cin, kh*kw, Ho, Wo]
+        col = col.reshape(N, groups, Cin // groups, kh * kw, Ho, Wo)
+        wg = w.astype(jnp.float32).reshape(
+            groups, Cout // groups, Cin_g, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", col, wg)
+        out = out.reshape(N, Cout, Ho, Wo).astype(xa.dtype)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    return apply_op(_f, *args, op_name="deform_conv2d")
 
 
 for _n in ["nms", "box_iou", "roi_align"]:
@@ -521,3 +611,38 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, probs, nums
     return rois, probs
+
+
+class DeformConv2D:
+    """Layer face of deform_conv2d (reference: paddle.vision.ops.
+    DeformConv2D). Holds weight/bias; offsets (and v2 mask) are inputs."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
+                padding=0, dilation=1, deformable_groups=1, groups=1,
+                weight_attr=None, bias_attr=None):
+        from ..nn.layer.layers import Layer, Parameter
+        import jax
+
+        kh = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        kw = kernel_size if isinstance(kernel_size, int) else kernel_size[1]
+
+        class _DeformConv2D(Layer):
+            def __init__(self):
+                super().__init__()
+                fan_in = in_channels * kh * kw
+                bound = 1.0 / (fan_in ** 0.5)
+                key = jax.random.PRNGKey(0)
+                self.weight = Parameter(jax.random.uniform(
+                    key, (out_channels, in_channels // groups, kh, kw),
+                    jnp.float32, -bound, bound))
+                self.bias = None if bias_attr is False else Parameter(
+                    jnp.zeros((out_channels,), jnp.float32))
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(
+                    x, offset, self.weight, bias=self.bias, stride=stride,
+                    padding=padding, dilation=dilation,
+                    deformable_groups=deformable_groups, groups=groups,
+                    mask=mask)
+
+        return _DeformConv2D()

@@ -258,3 +258,45 @@ def test_distribute_fpn_proposals_per_image_counts():
     # level 2 gets rois 0 (img 0) and 2 (img 1); level 3 gets roi 1 (img 0)
     assert nums[0].numpy().tolist() == [1, 1]
     assert nums[1].numpy().tolist() == [1, 0]
+
+
+def test_deform_conv2d_zero_offsets_equals_conv():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 3, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((2, 3, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 18, 5, 5), np.float32)
+    got = vops.deform_conv2d(T(x), T(off), T(w), padding=1).numpy()
+    want = F.conv2d(T(x), T(w), padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_deform_conv2d_shifted_offsets_translate_sampling():
+    # constant offset (+1, 0) samples one row lower: equals conv of the
+    # shifted input wherever the shift stays in-bounds
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((1, 2, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    off[:, 0::2] = 1.0  # dy=+1 for every kernel position
+    got = vops.deform_conv2d(T(x), T(off), T(w), padding=1).numpy()
+    shifted = np.roll(x, -1, axis=2)
+    want = F.conv2d(T(shifted), T(w), padding=1).numpy()
+    # rows 1..3: away from the top edge (where deform's shifted sample
+    # is in-bounds but the rolled reference sees padding) and from the
+    # wrapped bottom rows
+    np.testing.assert_allclose(got[:, :, 1:4], want[:, :, 1:4],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_deform_conv2d_layer_and_grads():
+    layer = vops.DeformConv2D(3, 4, 3, padding=1)
+    x = T(np.random.default_rng(2).standard_normal((2, 3, 5, 5))
+          .astype(np.float32))
+    off = paddle.to_tensor(
+        (np.random.default_rng(3).standard_normal((2, 18, 5, 5)) * 0.3)
+        .astype(np.float32), stop_gradient=False)
+    out = layer(x, off)
+    assert list(out.shape) == [2, 4, 5, 5]
+    out.sum().backward()
+    assert np.isfinite(np.asarray(off.grad._array)).all()
+    assert layer.weight.grad is not None
