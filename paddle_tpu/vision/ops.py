@@ -87,17 +87,6 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (rh / oh)[:, None]
         xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (rw / ow)[:, None]
 
-        def bilinear(fmap, yy, xx):
-            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
-            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
-            y1_ = jnp.clip(y0 + 1, 0, h - 1)
-            x1_ = jnp.clip(x0 + 1, 0, w - 1)
-            wy = yy - y0
-            wx = xx - x0
-            v00 = fmap[:, y0][:, :, x0]
-            # vectorized gather per roi handled below instead
-            return None
-
         outs = []
         for r in range(n_roi):
             fmap = feat[batch_idx[r]]  # [C,H,W]
@@ -109,9 +98,6 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             x1_ = jnp.clip(x0 + 1, 0, w - 1)
             wy = jnp.clip(yy - y0, 0, 1)
             wx = jnp.clip(xx - x0, 0, 1)
-            g = lambda yi, xi: fmap[:, yi.squeeze(-1) if yi.ndim > 2 else yi,
-                                    :][:, :, xi.squeeze(0) if xi.ndim > 2
-                                       else xi]
             v00 = fmap[:, y0[:, 0]][:, :, x0[0, :]]
             v01 = fmap[:, y0[:, 0]][:, :, x1_[0, :]]
             v10 = fmap[:, y1_[:, 0]][:, :, x0[0, :]]
