@@ -1,0 +1,91 @@
+"""MoE expert parallelism on a mesh (BASELINE config #5 class).
+
+Reference analog: the collective MoE tests (test_collective_global_*,
+moe_layer over global_scatter/gather NCCL all-to-all). Here the expert
+axis of the MoE weights shards over 'dp' per models/llama.param_specs,
+and GSPMD lowers the dense dispatch/combine einsums to the all-to-all —
+asserted by running a jitted loss+grad step on the 8-virtual-device mesh
+with sharded placements and checking shardings, finiteness, and parity
+with the unsharded computation.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.models import llama
+
+pytestmark = pytest.mark.slow
+
+
+def _moe_cfg():
+    return llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        dtype=jnp.float32, use_remat=False,
+        moe_num_experts=8, moe_top_k=2, moe_capacity_factor=2.0)
+
+
+def test_moe_expert_parallel_step_on_mesh():
+    cfg = _moe_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    specs = llama.param_specs(cfg)
+
+    devs = np.array(jax.devices("cpu")[:8]).reshape(4, 1, 2)
+    mesh = Mesh(devs, ("dp", "pp", "mp"))
+
+    placed = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: not isinstance(x, dict))
+
+    # expert weights sharded over dp (=ep): 8 experts / 4 dp shards
+    wg = placed["layers"]["w_gate"]
+    assert wg.sharding.spec == P("pp", "dp", None, "mp")
+    assert not wg.sharding.is_fully_replicated
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 128)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 128)
+    batch = {
+        "input_ids": jax.device_put(
+            ids, NamedSharding(mesh, P("dp", None))),
+        "labels": jax.device_put(
+            labels, NamedSharding(mesh, P("dp", None))),
+    }
+
+    @jax.jit
+    def step(p, b):
+        (total, ce), grads = jax.value_and_grad(
+            lambda q: llama.loss_fn(cfg, q, b), has_aux=True)(p)
+        return total, ce, grads
+
+    with mesh:
+        total, ce, grads = step(placed, batch)
+    assert np.isfinite(float(total)) and np.isfinite(float(ce))
+    # gradient placement follows the expert sharding (no silent
+    # full-replication of expert weights through the backward)
+    gw = grads["layers"]["w_gate"]
+    assert gw.sharding.is_equivalent_to(wg.sharding, gw.ndim)
+    assert not gw.sharding.is_fully_replicated
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    # parity with the unsharded computation
+    plain_total, _ = llama.loss_fn(cfg, params,
+                                   {"input_ids": ids, "labels": labels})
+    np.testing.assert_allclose(float(total), float(plain_total),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_capacity_drops_are_bounded():
+    """Sanity on the GShard capacity math: with a generous factor no
+    token is dropped, so top-1 gate mass reaches the output."""
+    cfg = _moe_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 16), 0, 128)
+    logits, aux = llama.forward_pure(cfg, params, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert float(aux) > 0.0  # load-balancing aux loss engaged
