@@ -1,0 +1,27 @@
+"""Generation example: KV-cache decoding with a (randomly initialized)
+GPT — swap in converted PaddleNLP/HF weights via paddle_tpu.models.convert
+for real text.
+
+Run: python examples/generate_text.py
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+
+def main():
+    net = GPTForCausalLM(GPTConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=128,
+        dtype=jnp.float32))
+    prompt = np.array([[1, 2, 3, 4]], np.int64)
+    greedy = net.generate(prompt, max_new_tokens=16, temperature=0.0)
+    sampled = net.generate(prompt, max_new_tokens=16, temperature=0.9,
+                           top_k=20, seed=7)
+    print("greedy :", greedy.numpy()[0].tolist())
+    print("sampled:", sampled.numpy()[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+/* C serving host example: link against libpaddle_tpu_capi.so and serve a
+ * jit.save artifact from pure C.
+ *
+ * Build:
+ *   make -C csrc capi
+ *   gcc examples/serve_capi.c -o serve -Icsrc -Lcsrc -lpaddle_tpu_capi \
+ *       -Wl,-rpath,$PWD/csrc
+ * Run (after saving a model with paddle_tpu.jit.save(net, "model", ...)):
+ *   PYTHONPATH=$PWD ./serve model
+ */
+#include <stdio.h>
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) { fprintf(stderr, "usage: %s <model_prefix>\n", argv[0]);
+                  return 2; }
+  PD_Predictor* p = PD_PredictorCreate(argv[1]);
+  if (!p) { fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+            return 1; }
+  float input[8] = {0};
+  PD_TensorData in = {PD_DTYPE_FLOAT32, 2, {1, 8}, input};
+  PD_TensorData* outs; int n;
+  if (PD_PredictorRun(p, &in, 1, &outs, &n) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("outputs: %d; first tensor dims:", n);
+  for (int d = 0; d < outs[0].ndim; ++d)
+    printf(" %lld", (long long)outs[0].shape[d]);
+  printf("\n");
+  PD_OutputsDestroy(outs, n);
+  PD_PredictorDestroy(p);
+  return 0;
+}

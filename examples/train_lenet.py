@@ -1,0 +1,35 @@
+"""Eager training example: LeNet on synthetic MNIST.
+
+Run: python examples/train_lenet.py  (CPU or TPU; finishes in ~1 min)
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+
+
+def main():
+    paddle.seed(0)
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    loader = DataLoader(MNIST(backend="synthetic"), batch_size=64,
+                        shuffle=True)
+    losses = []
+    for step, (img, label) in enumerate(loader):
+        loss = loss_fn(net(img), paddle.reshape(label, [-1]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        if step >= 30:
+            break
+    print(f"lenet: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+    assert losses[-1] < losses[0]
+
+
+if __name__ == "__main__":
+    main()
