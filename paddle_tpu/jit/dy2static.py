@@ -274,6 +274,55 @@ class _Rewriter(ast.NodeTransformer):
         stmts.extend(self._cleanup_stmts(names))
         return stmts
 
+    def visit_For(self, node):
+        """`for <name> in range(...)` desugars to the while machinery
+        (reference: dy2static loop_transformer's for->while lowering), so
+        traced loop bounds work. Other iterables keep python semantics —
+        they unroll at trace time, which is correct for static
+        containers."""
+        self.generic_visit(node)
+        if (node.orelse or _has_blocker(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not 1 <= len(node.iter.args) <= 3):
+            return node
+        var = node.target.id
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+        stop_n, step_n = self._fresh("stop"), self._fresh("step")
+        pre = [
+            ast.Assign(targets=[ast.Name(id=stop_n, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=step_n, ctx=ast.Store())],
+                       value=step),
+            ast.Assign(targets=[ast.Name(id=var, ctx=ast.Store())],
+                       value=start),
+        ]
+        # (stop - i) * step > 0 — one comparison, correct for both signs
+        test = ast.Compare(
+            left=ast.BinOp(
+                left=ast.BinOp(
+                    left=ast.Name(id=stop_n, ctx=ast.Load()),
+                    op=ast.Sub(),
+                    right=ast.Name(id=var, ctx=ast.Load())),
+                op=ast.Mult(),
+                right=ast.Name(id=step_n, ctx=ast.Load())),
+            ops=[ast.Gt()], comparators=[ast.Constant(value=0)])
+        bump = ast.Assign(
+            targets=[ast.Name(id=var, ctx=ast.Store())],
+            value=ast.BinOp(left=ast.Name(id=var, ctx=ast.Load()),
+                            op=ast.Add(),
+                            right=ast.Name(id=step_n, ctx=ast.Load())))
+        loop = ast.While(test=test, body=list(node.body) + [bump],
+                         orelse=[])
+        lowered = self.visit_While(loop)
+        return pre + (lowered if isinstance(lowered, list) else [lowered])
+
     def visit_While(self, node):
         self.generic_visit(node)
         if node.orelse or _has_blocker(node.body):

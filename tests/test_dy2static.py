@@ -9,6 +9,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.jit.dy2static import convert_to_static, UNDEFINED
+from paddle_tpu.jit import to_static
 
 
 def test_if_both_branches_traced():
@@ -257,3 +258,50 @@ def test_elif_chain_no_branch_taken():
     # neither branch assigns y; y is never used — must not crash
     np.testing.assert_allclose(conv(x, False, False).numpy(), [5.0])
     np.testing.assert_allclose(conv(x, True, False).numpy(), [5.0])
+
+
+def test_for_range_converts_to_while():
+    """for-over-range desugars into the while machinery (reference:
+    loop_transformer's for->while lowering), so traced bodies compile
+    as one lax.while_loop instead of unrolling."""
+    @to_static
+    def cumsum_to(n):
+        total = paddle.to_tensor(np.float32(0))
+        for i in range(n):
+            total = total + i
+        return total
+
+    assert float(cumsum_to(5).numpy()) == 10.0
+
+
+def test_for_range_negative_step_and_nested_if():
+    @to_static
+    def countdown(n):
+        s = paddle.to_tensor(np.float32(0))
+        for i in range(n, 0, -2):
+            s = s + i
+        return s
+
+    assert float(countdown(6).numpy()) == 12.0
+
+    @to_static
+    def nested(n):
+        acc = paddle.to_tensor(np.float32(0))
+        for i in range(n):
+            if i % 2 == 0:
+                acc = acc + 1.0
+            else:
+                acc = acc + 0.5
+        return acc
+
+    assert float(nested(4).numpy()) == 3.0
+
+
+def test_for_non_range_iterable_unrolls():
+    def plain(xs):
+        acc = paddle.to_tensor(np.float32(0))
+        for x in xs:
+            acc = acc + x
+        return acc
+
+    assert float(to_static(plain)([1.0, 2.0, 3.0]).numpy()) == 6.0
