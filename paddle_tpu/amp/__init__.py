@@ -232,3 +232,42 @@ class GradScaler:
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity (python/paddle/amp/auto_cast.py
+    decorate/amp_decorate): O2 casts the model's float parameters to the
+    low precision dtype and switches the optimizer(s) to fp32
+    master-weight updates (the multi_precision contract of the fused
+    optimizer kernels). O1 returns everything unchanged — per-op list
+    casting happens inside auto_cast.
+
+    Returns (models, optimizers) with the same single/list structure the
+    caller passed.
+    """
+    import jax.numpy as jnp
+
+    if level not in ("O1", "O2"):
+        raise ValueError(f"level must be 'O1' or 'O2', got {level!r}")
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    single_opt = optimizers is not None and \
+        not isinstance(optimizers, (list, tuple))
+    opt_list = [] if optimizers is None else (
+        [optimizers] if single_opt else list(optimizers))
+
+    if level == "O2":
+        low = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        for m in model_list:
+            for p in m.parameters():
+                if p._array.dtype in (jnp.float32, jnp.float64):
+                    p._set_array(p._array.astype(low))
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._use_master_weights = True
+
+    models_out = model_list[0] if single_model else model_list
+    if optimizers is None:
+        return models_out
+    return models_out, (opt_list[0] if single_opt else opt_list)

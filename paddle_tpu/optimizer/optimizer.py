@@ -97,8 +97,22 @@ class Optimizer:
                     jnp.float32)
             lr = self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
                 if hasattr(p, "optimize_attr") else self.get_lr()
-            new = self._update(p, garr, lr)
-            p._set_array(new.astype(p._array.dtype))
+            if getattr(self, "_use_master_weights", False) \
+                    and p._array.dtype in (jnp.bfloat16, jnp.float16):
+                # AMP O2 (amp.decorate): the update rule runs on an fp32
+                # master copy; the low-precision param is a cast of it.
+                # Reference: multi_precision optimizer kernels + the
+                # master-weight slots in fused adamw (phi optimizers).
+                master = self._acc("master_weight", p,
+                                   init=p._array.astype(jnp.float32))
+                low_dtype = p._array.dtype
+                p._set_array(master)
+                new = self._update(p, garr, lr).astype(jnp.float32)
+                self._set_acc("master_weight", p, new)
+                p._set_array(new.astype(low_dtype))
+            else:
+                new = self._update(p, garr, lr)
+                p._set_array(new.astype(p._array.dtype))
 
     def _use_decoupled_wd(self):
         return False

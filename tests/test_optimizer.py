@@ -153,3 +153,40 @@ class TestSchedulers:
         s.step(1.0)
         s.step(1.0)
         assert s() == pytest.approx(0.05)
+
+
+def test_amp_o2_decorate_master_weights():
+    """amp.decorate O2: bf16 params + fp32 master-weight updates
+    (reference: amp_decorate + the multi_precision fused optimizers)."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    for p in net.parameters():
+        assert p._array.dtype == jnp.bfloat16
+
+    rng = np.random.default_rng(0)
+    xs = paddle.to_tensor(rng.standard_normal((64, 8)).astype("float32"))
+    w = rng.standard_normal((8, 1)).astype("float32")
+    ys = paddle.to_tensor((xs.numpy() @ w).astype("float32"))
+    losses = []
+    for _ in range(60):
+        loss = nn.functional.mse_loss(net(xs), ys)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5
+    mw = next(iter(opt._accumulators["master_weight"].values()))
+    assert mw.dtype == jnp.float32
+    assert any(k.endswith("_master_weight") for k in opt.state_dict())
+    for p in net.parameters():
+        assert p._array.dtype == jnp.bfloat16
+    # O1 decorate is a no-op on params
+    net2 = nn.Linear(4, 4)
+    out = paddle.amp.decorate(net2, level="O1")
+    assert out.weight._array.dtype == jnp.float32
