@@ -141,3 +141,24 @@ def test_flash_nondefault_blocks_numerics():
                                        err_msg=f"d{name} mismatch")
     finally:
         pallas_ops._INTERPRET = old
+
+
+def test_committed_bench_cache_short_circuits_tuning():
+    """bench.py seeds tuning from .flash_autotune.json; a cache hit must
+    return the winner without measuring (no device work)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, ".flash_autotune.json")
+    assert os.path.exists(path)
+    autotune.load(path)
+    old = pallas_ops._INTERPRET
+    pallas_ops._INTERPRET = True  # satisfies the backend gate
+    try:
+        got = pallas_ops.tune_causal_attention(
+            B=4, S=2048, H=16, D=128, dtype=jnp.bfloat16)
+    finally:
+        pallas_ops._INTERPRET = old
+    assert tuple(got) == (512, 512)
+    # and the train-path block selection consumes it
+    assert pallas_ops._block_config(2048, 128, jnp.bfloat16) == (512, 512)
