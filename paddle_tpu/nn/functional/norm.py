@@ -28,21 +28,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch_stats = training and not use_global_stats
 
     args = [x]
-    names = []
     for t in (weight, bias):
         if t is not None:
             args.append(_ensure_tensor(t))
     has_w = weight is not None
     has_b = bias is not None
 
-    rm = running_mean._array if isinstance(running_mean, Tensor) else running_mean
-    rv = running_var._array if isinstance(running_var, Tensor) else running_var
+    # running stats travel as op INPUTS (not closure constants) so a
+    # recorded static program reads their LIVE values on every replay
+    rm_t = running_mean if isinstance(running_mean, Tensor) \
+        else Tensor(jnp.asarray(running_mean))
+    rv_t = running_var if isinstance(running_var, Tensor) \
+        else Tensor(jnp.asarray(running_var))
+    args += [rm_t, rv_t]
 
-    def _f(a, *wb):
+    def _f(a, *rest):
         i = 0
-        w = wb[i] if has_w else None
+        w = rest[i] if has_w else None
         i += 1 if has_w else 0
-        b = wb[i] if has_b else None
+        b = rest[i] if has_b else None
+        i += 1 if has_b else 0
+        rm, rv = rest[i], rest[i + 1]
         if use_batch_stats:
             mean = jnp.mean(a, axis=reduce_axes)
             var = jnp.var(a, axis=reduce_axes)
@@ -62,16 +68,35 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     # update running stats in place (matches reference's in-place update);
     # works under trace too — the new stats become traced values the caller's
     # functional step can return. Stats are the ones computed inside _f,
-    # not a second reduction over x.
+    # not a second reduction over x. The updates themselves go through
+    # apply_op so static programs record them; record_state_write makes
+    # the Executor persist them into the live buffers each run.
     if use_batch_stats and isinstance(running_mean, Tensor):
-        n = 1
-        for ax in reduce_axes:
-            n *= x._array.shape[ax]
-        unbiased = batch_var._array * (n / max(n - 1, 1))
-        running_mean._set_array(momentum * running_mean._array
-                                + (1 - momentum) * batch_mean._array)
-        running_var._set_array(momentum * running_var._array
-                               + (1 - momentum) * unbiased)
+        def _upd_var(v, bv, a):
+            # unbiased correction from the RUN-time batch (a.shape is the
+            # fed shape under the per-signature static replay, not the
+            # build placeholder's)
+            n = 1
+            for ax in reduce_axes:
+                n *= a.shape[ax]
+            return momentum * v + (1 - momentum) * (bv * (n / max(n - 1, 1)))
+
+        new_mean = apply_op(
+            lambda m, bm: momentum * m + (1 - momentum) * bm,
+            rm_t, batch_mean, op_name="bn_update_mean")
+        new_var = apply_op(_upd_var, rv_t, batch_var, x,
+                           op_name="bn_update_var")
+        from ...static.program import record_state_write, recording_program
+        if recording_program() is None:
+            # eager: apply in place, the reference's semantics
+            running_mean._set_array(new_mean._array)
+            running_var._set_array(new_var._array)
+        else:
+            # recording: the build runs on placeholder zeros — mutating
+            # the live buffers now would decay real (checkpoint-loaded)
+            # stats; the Executor persists the replayed values instead
+            record_state_write(running_mean, new_mean)
+            record_state_write(running_var, new_var)
     return out
 
 

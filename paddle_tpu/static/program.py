@@ -21,10 +21,13 @@ Build-time evaluation note: ops run eagerly on placeholder zeros while
 the program is being built (shape inference for free — the InferMeta
 analog); the recorded pure_fns are shape-polymorphic jnp code, so
 Executor.run may feed any batch size regardless of the placeholder's.
-Layers that mutate their own state outside the op stream (BatchNorm
-running stats) update at build time only — inside Executor.run the
-replay is pure; use eager/hapi training where live running-stat updates
-matter.
+Layer state that mutates during the forward (BatchNorm running stats)
+is handled by recorded state-writes (record_state_write): the replay
+computes the new values and the Executor persists them into the live
+buffers after each run — the in-place-update-on-persistable-variable
+semantics of the reference. clone(for_test=True) strips optimizer and
+state-writes but replays ops in their build-time mode; rebuild the
+program under layer.eval() for inference-mode normalization.
 """
 from __future__ import annotations
 
@@ -58,10 +61,21 @@ class Program:
         self._ops: List[_OpRecord] = []
         self._feeds: Dict[str, Tensor] = {}
         self._opt = None          # (optimizer, loss Tensor) from minimize
+        # (live tensor, graph value) pairs: layer state the replay must
+        # persist after each run (BatchNorm running stats — the
+        # reference's in-place updates on persistable variables)
+        self._state_writes: List[tuple] = []
+        # id(live state tensor) -> latest graph value: later recorded
+        # reads of the state chain onto the pending update (a BN layer
+        # invoked twice in one program accumulates both batches, like the
+        # reference's chained in-place ops)
+        self._state_alias: Dict[int, Tensor] = {}
         self._cache: Dict[tuple, object] = {}
 
     # -- build side ---------------------------------------------------------
     def _record(self, pure_fn, inputs, outputs, op_name):
+        if self._state_alias:
+            inputs = [self._state_alias.get(id(t), t) for t in inputs]
         self._ops.append(_OpRecord(pure_fn, inputs, outputs, op_name))
         self._cache.clear()
 
@@ -72,11 +86,15 @@ class Program:
 
     def clone(self, for_test=False):
         """Share the recorded graph; a for_test clone drops the optimizer
-        (reference: Program.clone(for_test=True) strips backward ops)."""
+        and the state writes (reference: Program.clone(for_test=True)
+        strips backward + in-place stat-update ops). Ops replay in their
+        build-time mode — rebuild under layer.eval() when inference-mode
+        layer behavior (BN normalizing by running stats) is needed."""
         p = Program()
         p._ops = self._ops
         p._feeds = self._feeds
         p._opt = None if for_test else self._opt
+        p._state_writes = [] if for_test else self._state_writes
         return p
 
     def global_block(self):
@@ -186,6 +204,19 @@ def _record_hook(pure_fn, inputs, outputs, op_name):
         prog._record(pure_fn, inputs, outputs, op_name)
 
 
+def record_state_write(dst: Tensor, src: Tensor):
+    """Layers call this when they mutate persistent state during the
+    build (BatchNorm running stats): the Executor re-computes ``src``
+    each run and writes it back into the live ``dst`` tensor. Later
+    recorded reads of ``dst`` resolve to ``src``, chaining repeated
+    updates within one program."""
+    prog = recording_program()
+    if prog is not None:
+        prog._state_writes.append((dst, src))
+        prog._state_alias[id(dst)] = src
+        prog._cache.clear()
+
+
 class program_guard:
     """Context manager scoping recording to the given programs
     (reference: paddle.static.program_guard)."""
@@ -273,6 +304,7 @@ class Executor:
 
         train = program._opt is not None
         key = (len(program._ops), fetch_ids, train,
+               len(program._state_writes),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays))
         fn = program._cache.get(key)
         if fn is None:
@@ -287,13 +319,15 @@ class Executor:
                 # trainables yet; bind them now (stable order: capture
                 # order, which is op order)
                 opt._parameter_list = trainable
-            fetch_vals, grads = fn(feed_arrays, cap_arrays)
+            fetch_vals, state_vals, grads = fn(feed_arrays, cap_arrays)
             for p, g in zip(trainable, grads):
                 p.grad = Tensor(g)
             opt.step()
             opt.clear_grad()
         else:
-            fetch_vals = fn(feed_arrays, cap_arrays)
+            fetch_vals, state_vals = fn(feed_arrays, cap_arrays)
+        for (dst, _src), val in zip(program._state_writes, state_vals):
+            dst._set_array(val)
         if return_numpy:
             return [np.asarray(v) for v in fetch_vals]
         return [Tensor(v) for v in fetch_vals]
@@ -302,17 +336,20 @@ class Executor:
         feed_ts = [t for _, t in feeds]
         trainable_idx = [i for i, t in enumerate(caps)
                          if not t.stop_gradient]
+        state_srcs = [src for _dst, src in program._state_writes]
 
         def forward(feed_arrays, cap_arrays):
             env = {id(t): a for t, a in zip(feed_ts, feed_arrays)}
             env.update({id(t): a for t, a in zip(caps, cap_arrays)})
             program._replay(env)
-            return [env[id(t)] for t in fetch_list], env
+            return ([env[id(t)] for t in fetch_list],
+                    [env[id(t)] for t in state_srcs], env)
 
         if not train:
             @jax.jit
             def infer(feed_arrays, cap_arrays):
-                return forward(feed_arrays, cap_arrays)[0]
+                fetches, svals, _env = forward(feed_arrays, cap_arrays)
+                return fetches, svals
             return infer
 
         opt, loss_t = program._opt
@@ -323,12 +360,12 @@ class Executor:
                 full = list(cap_arrays)
                 for i, a in zip(trainable_idx, train_arrays):
                     full[i] = a
-                fetches, env = forward(feed_arrays, full)
+                fetches, svals, env = forward(feed_arrays, full)
                 return env[id(loss_t)].astype(jax.numpy.float32).sum(), \
-                    fetches
+                    (fetches, svals)
             train_arrays = [cap_arrays[i] for i in trainable_idx]
-            (_, fetches), grads = jax.value_and_grad(
+            (_, (fetches, svals)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_arrays)
-            return fetches, grads
+            return fetches, svals, grads
 
         return train_step

@@ -163,3 +163,84 @@ def test_save_load_inference_model_roundtrip(tmp_path):
     pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
     outs = pred.run([xs])
     np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_running_stats_update_across_runs():
+    """Recorded state-writes: BN running stats move with every
+    Executor.run (reference: in-place updates on persistable variables),
+    and clone(for_test=True) freezes them."""
+    import paddle_tpu.nn as nn
+
+    main = static.Program()
+    with static.program_guard(main):
+        paddle.seed(0)
+        x = static.data("x", [None, 4])
+        bn = nn.BatchNorm1D(4)
+        bn.train()
+        y = bn(x)
+        loss = paddle.mean(y * y)
+        paddle.optimizer.SGD(0.01).minimize(loss)
+
+    exe = static.Executor()
+    rng = np.random.default_rng(0)
+    before = bn._mean.numpy().copy()
+    for _ in range(5):
+        xs = (rng.standard_normal((32, 4)) * 3 + 7).astype("float32")
+        exe.run(main, feed={"x": xs}, fetch_list=[loss])
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+    assert np.all(after > 1.0)  # moving toward the data mean ~7
+
+    frozen = after.copy()
+    test_prog = main.clone(for_test=True)
+    exe.run(test_prog, feed={"x": np.ones((8, 4), "float32")},
+            fetch_list=[y])
+    np.testing.assert_array_equal(bn._mean.numpy(), frozen)
+
+
+def test_batchnorm_build_does_not_corrupt_stats_and_var_scale():
+    """Recording must not decay live stats (the build runs on placeholder
+    zeros), and the unbiased-variance correction must use the RUN batch
+    size, not the placeholder's."""
+    import paddle_tpu.nn as nn
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4])
+        bn = nn.BatchNorm1D(4)
+        bn.train()
+        _y = bn(x)
+    # building alone left the buffers untouched
+    np.testing.assert_array_equal(bn._mean.numpy(), np.zeros(4, "float32"))
+    np.testing.assert_array_equal(bn._variance.numpy(),
+                                  np.ones(4, "float32"))
+
+    exe = static.Executor()
+    xs = np.random.default_rng(0).standard_normal((32, 4)) \
+        .astype("float32")
+    exe.run(main, feed={"x": xs}, fetch_list=[_y])
+    want_var = 0.9 * 1.0 + 0.1 * xs.var(0) * (32 / 31)  # n from the run
+    np.testing.assert_allclose(bn._variance.numpy(), want_var,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_invoked_twice_chains_updates():
+    """One BN layer applied twice in a program accumulates BOTH batches
+    (the reference's chained in-place updates)."""
+    import paddle_tpu.nn as nn
+
+    main = static.Program()
+    with static.program_guard(main):
+        xa = static.data("a", [None, 2])
+        xb = static.data("b", [None, 2])
+        bn = nn.BatchNorm1D(2)
+        bn.train()
+        _ = bn(xa)
+        _out = bn(xb)
+    exe = static.Executor()
+    a = np.full((8, 2), 1.0, "float32")
+    b = np.full((8, 2), 5.0, "float32")
+    exe.run(main, feed={"a": a, "b": b}, fetch_list=[_out])
+    # chained: m1 = 0.9*0 + 0.1*1 = 0.1; m2 = 0.9*0.1 + 0.1*5 = 0.59
+    np.testing.assert_allclose(bn._mean.numpy(), [0.59, 0.59],
+                               rtol=1e-5, atol=1e-6)
