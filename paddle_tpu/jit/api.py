@@ -187,8 +187,35 @@ def save(layer, path, input_spec=None, **configs):
         with open(path + ".meta", "wb") as f:
             pickle.dump({"input_specs": [(s.shape, s.dtype.name)
                                          for s in specs]}, f)
+    elif callable(layer):
+        # plain functions / StaticFunctions save too (reference:
+        # jit.save(function, path, input_spec) — api.py:773 handles both)
+        fn = getattr(layer, "_function", None) or \
+            getattr(layer, "__wrapped__", None) or layer
+        if input_spec is None:
+            raise ValueError("jit.save requires input_spec for AOT export")
+        specs = [s if isinstance(s, InputSpec) else InputSpec(**s)
+                 for s in input_spec]
+        abstract = [jax.ShapeDtypeStruct(
+            [1 if d in (-1, None) else d for d in s.shape], s.dtype)
+            for s in specs]
+
+        def pure_forward(params_in, *xs):
+            del params_in  # functions carry no parameters
+            out = fn(*[Tensor(x) for x in xs])
+            return _tree_to_arrays(out)
+
+        from jax import export as jexport
+        exported = jexport.export(jax.jit(pure_forward))({}, *abstract)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        fsave({}, path + ".pdiparams")
+        with open(path + ".meta", "wb") as f:
+            pickle.dump({"input_specs": [(s.shape, s.dtype.name)
+                                         for s in specs]}, f)
     else:
-        raise TypeError("jit.save expects a Layer")
+        raise TypeError("jit.save expects a Layer or callable")
 
 
 class TranslatedLayer:

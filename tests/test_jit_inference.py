@@ -67,3 +67,26 @@ def test_predictor_serving_path():
     # positional API too
     outs = predictor.run([x])
     np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_function_save_load_roundtrip(tmp_path):
+    """jit.save accepts plain/to_static functions, not only Layers
+    (reference: jit/api.py:773 handles both), and the artifact serves
+    through load + Predictor."""
+    from paddle_tpu import inference
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def poly(x):
+        return x * x + 2.0 * x + 1.0
+
+    prefix = str(tmp_path / "fn_model")
+    paddle.jit.save(poly, prefix, input_spec=[InputSpec([4], "float32")])
+    loaded = paddle.jit.load(prefix)
+    x = np.arange(4, dtype=np.float32)
+    got = loaded(x)
+    got = got.numpy() if hasattr(got, "numpy") else got[0].numpy()
+    np.testing.assert_allclose(got, (x + 1) ** 2, rtol=1e-6)
+
+    pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+    np.testing.assert_allclose(pred.run([x])[0], (x + 1) ** 2, rtol=1e-6)
