@@ -99,3 +99,86 @@ def test_gpt2_logits_match_hf():
     with jax.default_matmul_precision("highest"):
         got = gpt.forward_pure(cfg, params, jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_ernie_encoder_matches_hf_bert():
+    """Our ERNIE encoder is the post-LN BERT architecture; with weights
+    synced from transformers.BertModel the sequence and pooled outputs
+    must match."""
+    from transformers import BertConfig as HFConfig
+    from transformers import BertModel as HFBert
+
+    from paddle_tpu.models import ernie
+
+    hf_cfg = HFConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_act="gelu_new",  # our encoder uses tanh-gelu
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12)
+    torch.manual_seed(3)
+    hf = HFBert(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    cfg = ernie.ErnieConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        layer_norm_eps=1e-12, dtype=jnp.float32)
+    L = cfg.num_hidden_layers
+
+    def stk(fmt, transpose=False):
+        arrs = [sd[fmt.format(i)] for i in range(L)]
+        if transpose:
+            arrs = [a.T for a in arrs]
+        return jnp.asarray(np.stack(arrs))
+
+    pre = "encoder.layer.{}."
+    params = {
+        "word_emb": jnp.asarray(sd["embeddings.word_embeddings.weight"]),
+        "pos_emb": jnp.asarray(
+            sd["embeddings.position_embeddings.weight"]),
+        "type_emb": jnp.asarray(
+            sd["embeddings.token_type_embeddings.weight"]),
+        "emb_ln_w": jnp.asarray(sd["embeddings.LayerNorm.weight"]),
+        "emb_ln_b": jnp.asarray(sd["embeddings.LayerNorm.bias"]),
+        "layers": {
+            "wq": stk(pre + "attention.self.query.weight", True),
+            "b_q": stk(pre + "attention.self.query.bias"),
+            "wk": stk(pre + "attention.self.key.weight", True),
+            "b_k": stk(pre + "attention.self.key.bias"),
+            "wv": stk(pre + "attention.self.value.weight", True),
+            "b_v": stk(pre + "attention.self.value.bias"),
+            "wo": stk(pre + "attention.output.dense.weight", True),
+            "b_o": stk(pre + "attention.output.dense.bias"),
+            "ln1_w": stk(pre + "attention.output.LayerNorm.weight"),
+            "ln1_b": stk(pre + "attention.output.LayerNorm.bias"),
+            "w1": stk(pre + "intermediate.dense.weight", True),
+            "b_1": stk(pre + "intermediate.dense.bias"),
+            "w2": stk(pre + "output.dense.weight", True),
+            "b_2": stk(pre + "output.dense.bias"),
+            "ln2_w": stk(pre + "output.LayerNorm.weight"),
+            "ln2_b": stk(pre + "output.LayerNorm.bias"),
+        },
+        "pooler_w": jnp.asarray(sd["pooler.dense.weight"].T),
+        "pooler_b": jnp.asarray(sd["pooler.dense.bias"]),
+    }
+    # heads unused by BertModel outputs
+    base = ernie.init_params(cfg, jax.random.PRNGKey(0))
+    for k in ("mlm_trans_w", "mlm_trans_b", "mlm_ln_w", "mlm_ln_b",
+              "mlm_bias", "nsp_w", "nsp_b"):
+        params[k] = base[k]
+
+    ids = np.random.default_rng(4).integers(0, 96, (2, 9))
+    with torch.no_grad():
+        hf_out = hf(torch.tensor(ids))
+        want_seq = hf_out.last_hidden_state.numpy()
+        want_pool = hf_out.pooler_output.numpy()
+    with jax.default_matmul_precision("highest"):
+        seq, pool = ernie.forward_pure(cfg, params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq), want_seq,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(pool), want_pool,
+                               rtol=2e-3, atol=2e-3)
