@@ -21,10 +21,13 @@ a taken traced branch.
 Conversion contract (documented subset, mirrors the reference's
 supported patterns):
 - `if`/`elif`/`else` and `while` with tensor or python predicates;
+- `for` over range(...) (desugared to while; other iterables unroll);
+- `break`/`continue` in converted loops (lowered to carried flags with
+  guarded tails — the reference break_continue_transformer strategy);
 - branch/loop bodies that assign plain names (tuple targets ok);
-- `return`/`break`/`continue` INSIDE a converted block are not
-  rewritten — functions containing them in tensor-predicated blocks
-  keep python semantics and will raise jax's loud tracer error;
+- `return`/`yield` INSIDE a converted block are not rewritten —
+  functions containing them in tensor-predicated blocks keep python
+  semantics and will raise jax's loud tracer error;
 - unsupported shapes of code (no retrievable source, lambdas, already-
   transformed callables) fall back to plain tracing, like the
   reference's ast fallback path.
@@ -85,14 +88,52 @@ def convert_ifelse(pred, true_fn, false_fn, vals):
     return cond(pred, checked(true_fn), checked(false_fn))
 
 
+def convert_not_any(a, b):
+    """``not (a or b)`` without python short-circuiting — the operands
+    may be traced break/continue flags, where ``or`` would call bool()
+    on a tracer."""
+    if _is_traced(a) or _is_traced(b):
+        import jax.numpy as jnp
+        return jnp.logical_not(jnp.logical_or(
+            getattr(a, "_array", a), getattr(b, "_array", b)))
+    return not (bool(getattr(a, "_array", a))
+                or bool(getattr(b, "_array", b)))
+
+
+def convert_and_not(cond, flag):
+    """``cond and not flag`` for loop tests, traced-aware."""
+    if _is_traced(cond) or _is_traced(flag):
+        import jax.numpy as jnp
+        return jnp.logical_and(
+            getattr(cond, "_array", cond),
+            jnp.logical_not(getattr(flag, "_array", flag)))
+    return bool(getattr(cond, "_array", cond)) and \
+        not bool(getattr(flag, "_array", flag))
+
+
+def convert_flag_off(flag):
+    """1 when the flag is unset, 0 when set (traced-aware) — multiplies
+    the for-loop index bump so `break` preserves the loop variable
+    (python leaves it at the breaking iteration) while `continue` still
+    advances it."""
+    if _is_traced(flag):
+        import jax.numpy as jnp
+        return jnp.where(getattr(flag, "_array", flag), 0, 1)
+    return 0 if bool(getattr(flag, "_array", flag)) else 1
+
+
 def convert_while_loop(cond_fn, body_fn, vals):
-    """Runtime dispatch for a rewritten `while`."""
+    """Runtime dispatch for a rewritten `while`. The probe can turn
+    traced MID-loop (a concrete range bound with a tensor-predicated
+    break: the first iterations run eagerly until the lax.cond makes the
+    flag a tracer) — re-dispatch to the traced path with the current
+    carry when that happens."""
     probe = cond_fn(*vals)
-    if not _is_traced(probe):
-        while bool(getattr(probe, "_array", probe)):
-            vals = body_fn(*vals)
-            probe = cond_fn(*vals)
-        return vals
+    while not _is_traced(probe):
+        if not bool(getattr(probe, "_array", probe)):
+            return vals
+        vals = body_fn(*vals)
+        probe = cond_fn(*vals)
     if any(v is UNDEFINED for v in vals):
         raise ValueError(
             "dy2static: a loop variable of a tensor-predicated `while` "
@@ -189,6 +230,8 @@ class _Rewriter(ast.NodeTransformer):
 
     def __init__(self, global_names=()):
         self.counter = 0
+        self.converted = 0  # actual conversions (fresh-name allocation
+        # alone must not defeat the caller's keep-original fallback)
         self.global_names = set(global_names)
 
     def _fresh(self, kind):
@@ -248,6 +291,135 @@ class _Rewriter(ast.NodeTransformer):
                 orelse=[]))
         return out
 
+    # -- break/continue lowering (loop_transformer's flag rewrite) -----
+    def _loop_interrupts_present(self, stmts):
+        """Break/Continue belonging to THIS loop: found in the block but
+        not inside a nested loop or function scope."""
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda,
+                                      ast.For, ast.While, ast.AsyncFor)):
+                    continue
+                if isinstance(child, (ast.Break, ast.Continue)):
+                    return True
+                if walk(child):
+                    return True
+            return False
+        return any(isinstance(s, (ast.Break, ast.Continue)) or walk(s)
+                   for s in stmts)
+
+    def _lower_loop_interrupts(self, stmts, brk, cont):
+        """Rewrite this loop's break/continue into flag assignments and
+        guard trailing statements so control falls to the loop bottom —
+        the reference's break_continue_transformer strategy. Statements
+        inside nested loops/functions are left alone (they belong to the
+        inner scope). Returns (lowered_stmts, may_interrupt)."""
+        def set_flag(name):
+            return ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Constant(value=True))
+
+        def no_flags():
+            return ast.Call(
+                func=ast.Name(id="__pt_not_any", ctx=ast.Load()),
+                args=[ast.Name(id=brk, ctx=ast.Load()),
+                      ast.Name(id=cont, ctx=ast.Load())],
+                keywords=[])
+
+        acc: list = []
+        may_any = False
+        for st in reversed(stmts):
+            if isinstance(st, ast.Break):
+                lowered, may = [set_flag(brk)], True
+            elif isinstance(st, ast.Continue):
+                lowered, may = [set_flag(cont)], True
+            elif isinstance(st, ast.If):
+                b, mb = self._lower_loop_interrupts(st.body, brk, cont)
+                o, mo = self._lower_loop_interrupts(st.orelse, brk, cont)
+                lowered = [ast.If(test=st.test, body=b or [ast.Pass()],
+                                  orelse=o)]
+                may = mb or mo
+            else:
+                lowered, may = [st], False
+            if may and acc:
+                acc = [ast.If(test=no_flags(), body=acc, orelse=[])]
+            acc = lowered + acc
+            may_any = may_any or may
+        return acc, may_any
+
+    @staticmethod
+    def _seed_read_name(st):
+        """The generated seed `x = locals().get('x', UNDEF)` reads x even
+        though no Name-load appears; recognize it so carry analysis sees
+        the read."""
+        if (isinstance(st, ast.Assign) and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr == "get"
+                and isinstance(st.value.func.value, ast.Call)
+                and isinstance(st.value.func.value.func, ast.Name)
+                and st.value.func.value.func.id == "locals"
+                and st.value.args
+                and isinstance(st.value.args[0], ast.Constant)):
+            return st.value.args[0].value
+        return None
+
+    def _iteration_locals(self, stmts, names):
+        """Subset of ``names`` that every iteration (re)binds by a
+        top-level Assign before any read: per-iteration temporaries (a
+        desugared inner loop's stop/step/loop-var), not loop state.
+        Dropping them from the carry is what lets NESTED range loops
+        convert — their temporaries would otherwise enter the outer
+        traced carry as UNDEFINED seeds."""
+        candidate = set(names)
+        defined: set = set()
+        must_carry: set = set()
+
+        def loads_of(node):
+            return {n.id for n in ast.walk(node)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)}
+
+        for st in stmts:
+            seed_name = self._seed_read_name(st)
+            if seed_name is not None:
+                if seed_name in candidate and seed_name not in defined:
+                    must_carry.add(seed_name)
+                defined.add(seed_name)
+                continue
+            if isinstance(st, ast.Assign):
+                reads = loads_of(st.value)
+                for t in st.targets:
+                    if not isinstance(t, ast.Name):
+                        reads |= loads_of(t)
+                must_carry |= (reads & candidate) - defined
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        defined.add(t.id)
+            elif isinstance(st, ast.AugAssign):
+                reads = loads_of(st.value)
+                if isinstance(st.target, ast.Name):
+                    reads.add(st.target.id)
+                must_carry |= (reads & candidate) - defined
+            else:
+                must_carry |= (loads_of(st) & candidate) - defined
+        return {n for n in candidate
+                if n in defined and n not in must_carry}
+
+    def _revisit(self, stmts):
+        """Run freshly generated statements through the transformer —
+        Ifs that were unconvertible while they held a break/continue
+        become convertible after the lowering replaced those with flag
+        assignments."""
+        out = []
+        for st in stmts:
+            r = self.visit(st)
+            if isinstance(r, list):
+                out.extend(r)
+            elif r is not None:
+                out.append(r)
+        return out
+
     # -- transforms ----------------------------------------------------
     def visit_If(self, node):
         self.generic_visit(node)
@@ -256,6 +428,7 @@ class _Rewriter(ast.NodeTransformer):
         names = _assigned_names(node.body + node.orelse)
         if not names or any(n in self.global_names for n in names):
             return node
+        self.converted += 1
         tname, fname = self._fresh("true"), self._fresh("false")
         stmts = [self._seed_stmt(n) for n in names]
         stmts.append(self._make_fn(tname, names, node.body, names))
@@ -281,13 +454,32 @@ class _Rewriter(ast.NodeTransformer):
         they unroll at trace time, which is correct for static
         containers."""
         self.generic_visit(node)
-        if (node.orelse or _has_blocker(node.body)
+        if (node.orelse
                 or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
                 or node.iter.func.id != "range"
                 or node.iter.keywords
                 or not 1 <= len(node.iter.args) <= 3):
+            return node
+        body_stmts = list(node.body)
+        flag_pre: list = []
+        flag_test = None
+        if self._loop_interrupts_present(body_stmts):
+            # lower here (not in visit_While) so the index bump below
+            # stays UNGUARDED: `continue` must still advance the loop var
+            brk, cont = self._fresh("brk"), self._fresh("cont")
+            body_stmts, _ = self._lower_loop_interrupts(body_stmts,
+                                                        brk, cont)
+            body_stmts = [ast.Assign(
+                targets=[ast.Name(id=cont, ctx=ast.Store())],
+                value=ast.Constant(value=False))] \
+                + self._revisit(body_stmts)
+            flag_pre = [ast.Assign(
+                targets=[ast.Name(id=n, ctx=ast.Store())],
+                value=ast.Constant(value=False)) for n in (brk, cont)]
+            flag_test = ast.Name(id=brk, ctx=ast.Load())
+        if _has_blocker(body_stmts):
             return node
         var = node.target.id
         a = node.iter.args
@@ -313,29 +505,69 @@ class _Rewriter(ast.NodeTransformer):
                 op=ast.Mult(),
                 right=ast.Name(id=step_n, ctx=ast.Load())),
             ops=[ast.Gt()], comparators=[ast.Constant(value=0)])
+        if flag_test is not None:
+            test = ast.Call(
+                func=ast.Name(id="__pt_and_not", ctx=ast.Load()),
+                args=[test, flag_test], keywords=[])
+        step_expr = ast.Name(id=step_n, ctx=ast.Load())
+        if flag_test is not None:
+            # break preserves the loop var (bump * 0 when brk set);
+            # continue still advances (cont does not zero the bump)
+            step_expr = ast.BinOp(
+                left=step_expr, op=ast.Mult(),
+                right=ast.Call(
+                    func=ast.Name(id="__pt_flag_off", ctx=ast.Load()),
+                    args=[ast.Name(id=flag_test.id, ctx=ast.Load())],
+                    keywords=[]))
         bump = ast.Assign(
             targets=[ast.Name(id=var, ctx=ast.Store())],
             value=ast.BinOp(left=ast.Name(id=var, ctx=ast.Load()),
-                            op=ast.Add(),
-                            right=ast.Name(id=step_n, ctx=ast.Load())))
-        loop = ast.While(test=test, body=list(node.body) + [bump],
+                            op=ast.Add(), right=step_expr))
+        loop = ast.While(test=test, body=body_stmts + [bump],
                          orelse=[])
         lowered = self.visit_While(loop)
-        return pre + (lowered if isinstance(lowered, list) else [lowered])
+        return pre + flag_pre + (lowered if isinstance(lowered, list)
+                                 else [lowered])
 
     def visit_While(self, node):
         self.generic_visit(node)
-        if node.orelse or _has_blocker(node.body):
+        if node.orelse:
             return node
-        names = _assigned_names(node.body)
+        work, pre = node, []
+        if self._loop_interrupts_present(node.body):
+            brk, cont = self._fresh("brk"), self._fresh("cont")
+            lowered, _ = self._lower_loop_interrupts(node.body, brk, cont)
+            body = [ast.Assign(
+                targets=[ast.Name(id=cont, ctx=ast.Store())],
+                value=ast.Constant(value=False))] \
+                + self._revisit(lowered)
+            test = ast.Call(
+                func=ast.Name(id="__pt_and_not", ctx=ast.Load()),
+                args=[node.test, ast.Name(id=brk, ctx=ast.Load())],
+                keywords=[])
+            work = ast.While(test=test, body=body, orelse=[])
+            pre = [ast.Assign(
+                targets=[ast.Name(id=n, ctx=ast.Store())],
+                value=ast.Constant(value=False)) for n in (brk, cont)]
+        if _has_blocker(work.body):
+            return node  # other control transfers remain unconvertible
+        all_names = _assigned_names(work.body)
+        local = self._iteration_locals(work.body, all_names)
+        # the loop test runs before the body each iteration: names it
+        # reads are loop state regardless of body-local rebinding
+        local -= {n.id for n in ast.walk(work.test)
+                  if isinstance(n, ast.Name)
+                  and isinstance(n.ctx, ast.Load)}
+        names = [n for n in all_names if n not in local]
         if not names or any(n in self.global_names for n in names):
             return node
+        self.converted += 1
         cname, bname = self._fresh("cond"), self._fresh("body")
-        stmts = [self._seed_stmt(n) for n in names]
+        stmts = pre + [self._seed_stmt(n) for n in names]
         cond_fn = self._make_fn(cname, names, [], [])
-        cond_fn.body = [ast.Return(value=node.test)]
+        cond_fn.body = [ast.Return(value=work.test)]
         stmts.append(cond_fn)
-        stmts.append(self._make_fn(bname, names, node.body, names))
+        stmts.append(self._make_fn(bname, names, work.body, names))
         call = ast.Call(
             func=ast.Name(id="__pt_convert_while", ctx=ast.Load()),
             args=[ast.Name(id=cname, ctx=ast.Load()),
@@ -409,7 +641,7 @@ def convert_to_static(fn: Callable) -> Callable:
 
     rewriter = _Rewriter(global_names)
     new_tree = rewriter.visit(tree)
-    if rewriter.counter == 0:
+    if rewriter.converted == 0:
         return fn  # nothing converted — keep the original object
     ast.fix_missing_locations(new_tree)
 
@@ -420,6 +652,9 @@ def convert_to_static(fn: Callable) -> Callable:
     glb.setdefault("__pt_convert_ifelse", convert_ifelse)
     glb.setdefault("__pt_convert_while", convert_while_loop)
     glb.setdefault("__pt_UNDEFINED", UNDEFINED)
+    glb.setdefault("__pt_not_any", convert_not_any)
+    glb.setdefault("__pt_and_not", convert_and_not)
+    glb.setdefault("__pt_flag_off", convert_flag_off)
     loc: Dict[str, Any] = {}
     code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
                    mode="exec")

@@ -305,3 +305,121 @@ def test_for_non_range_iterable_unrolls():
         return acc
 
     assert float(to_static(plain)([1.0, 2.0, 3.0]).numpy()) == 6.0
+
+
+def test_break_in_while_converts():
+    @to_static
+    def sum_until(n, limit):
+        s = paddle.to_tensor(np.float32(0))
+        i = 0
+        while i < n:
+            s = s + i
+            if s > limit:
+                break
+            i = i + 1
+        return s
+
+    def ref(n, limit):
+        s, i = 0.0, 0
+        while i < n:
+            s += i
+            if s > limit:
+                break
+            i += 1
+        return s
+
+    for n, lim in [(10, 6.0), (10, 1000.0), (3, 0.5)]:
+        assert float(sum_until(n, lim).numpy()) == ref(n, lim)
+
+
+def test_continue_and_break_in_for():
+    @to_static
+    def skip_evens(n):
+        s = paddle.to_tensor(np.float32(0))
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s
+
+    assert float(skip_evens(6).numpy()) == 9.0  # 1 + 3 + 5
+
+    @to_static
+    def mixed(n):
+        s = paddle.to_tensor(np.float32(0))
+        for i in range(n):
+            if i == 1:
+                continue
+            if i >= 4:
+                break
+            s = s + i
+        return s
+
+    assert float(mixed(10).numpy()) == 5.0  # 0 + 2 + 3
+
+
+def test_loop_var_preserved_after_break():
+    @to_static
+    def var_after_break(n):
+        s = paddle.to_tensor(np.float32(0))
+        i = 0
+        for i in range(n):
+            if i >= 3:
+                break
+            s = s + 1
+        return s + i
+
+    assert float(var_after_break(10).numpy()) == 6.0  # i stays 3
+
+
+def test_tensor_predicated_break_with_concrete_bounds():
+    @to_static
+    def tensor_break(limit):
+        s = paddle.to_tensor(np.float32(0))
+        for i in range(5):
+            s = s + 1.0
+            if s > limit:
+                break
+        return s
+
+    t = paddle.to_tensor
+    assert float(tensor_break(t(np.float32(3.0))).numpy()) == 4.0
+    assert float(tensor_break(t(np.float32(100.0))).numpy()) == 5.0
+
+
+def test_nested_range_loops_convert():
+    @to_static
+    def nested_loops(n):
+        s = paddle.to_tensor(np.float32(0))
+        for i in range(n):
+            for j in range(n):
+                s = s + 1
+        return s
+
+    assert float(nested_loops(4).numpy()) == 16.0
+
+    @to_static
+    def nested_break(n):
+        s = paddle.to_tensor(np.float32(0))
+        for i in range(n):
+            for j in range(n):
+                if j >= 2:
+                    break
+                s = s + 1
+        return s
+
+    assert float(nested_break(5).numpy()) == 10.0
+
+
+def test_unconvertible_function_keeps_original_object():
+    def with_try(n):
+        s = paddle.to_tensor(np.float32(0))
+        while n > 0:
+            try:
+                s = s + 1
+            finally:
+                pass
+            return s
+        return s
+
+    assert convert_to_static(with_try) is with_try
