@@ -33,3 +33,10 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # Success: skip C++ static destructors — PJRT/TSL thread pools can
+    # abort at interpreter shutdown after training already succeeded.
+    import os
+    import sys
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)

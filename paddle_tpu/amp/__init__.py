@@ -234,6 +234,23 @@ class GradScaler:
         self._bad_steps = sd.get("bad_steps", 0)
 
 
+def _norm_param_ids(model):
+    """ids of parameters owned by normalization layers — O2 keeps these
+    fp32 (reference: amp_decorate keep_batch_norm_fp32; norm scale/bias
+    in low precision destabilizes the running statistics and the tiny
+    per-channel affine terms)."""
+    from ..nn.layer import norm as _norm
+
+    norm_types = (_norm._BatchNormBase, _norm.LayerNorm, _norm.RMSNorm,
+                  _norm.GroupNorm, _norm._InstanceNormBase)
+    ids = set()
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, norm_types):
+            for p in layer.parameters(include_sublayers=False):
+                ids.add(id(p))
+    return ids
+
+
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
     """paddle.amp.decorate parity (python/paddle/amp/auto_cast.py
@@ -260,7 +277,10 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     if level == "O2":
         low = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
         for m in model_list:
+            keep_fp32 = _norm_param_ids(m)
             for p in m.parameters():
+                if id(p) in keep_fp32:
+                    continue
                 if p._array.dtype in (jnp.float32, jnp.float64):
                     p._set_array(p._array.astype(low))
         for opt in opt_list:

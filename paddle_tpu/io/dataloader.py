@@ -184,8 +184,69 @@ class DataLoader:
             samples = [self.dataset[i] for i in indices]
             yield _to_tensor_tree(self.collate_fn(samples))
 
+    def _start_context(self):
+        """Pick the worker start method (cached after the first call —
+        picklability of the payload cannot change between epochs).
+
+        spawn by default: the parent holds a live multithreaded XLA/PJRT
+        client, and forking it risks the TSL "Expected N threads to join"
+        abort at shutdown (reference analog keeps fork because its C++
+        runtime is fork-aware; ours is not). NOTE: spawn re-imports
+        __main__ in each worker, so scripts that iterate a
+        num_workers>0 DataLoader at module top level need the standard
+        ``if __name__ == "__main__"`` guard. Fork remains a fallback for
+        datasets/collate_fns that cannot pickle (e.g. defined in a local
+        scope), with a warning.
+        """
+        if getattr(self, "_mp_ctx", None) is not None:
+            return self._mp_ctx
+        import os
+        import pickle
+        import sys
+        import warnings
+
+        class _NullWriter:
+            def write(self, _):
+                pass  # probe picklability without materializing bytes
+
+        reason = None
+        # spawn re-executes __main__: piped/stdin scripts have no real
+        # file to re-run and every worker would die at startup
+        main_file = getattr(sys.modules.get("__main__"), "__file__", None)
+        if main_file is not None and not os.path.exists(main_file):
+            reason = (f"__main__ has no importable file ({main_file!r}; "
+                      "stdin/exec script)")
+        if reason is None:
+            try:
+                pickle.Pickler(_NullWriter(), pickle.HIGHEST_PROTOCOL).dump(
+                    (self.dataset, self.collate_fn, self.worker_init_fn))
+            except Exception:
+                reason = "worker payload is not picklable"
+        if reason is None:
+            self._mp_ctx = mp.get_context("spawn")
+        else:
+            warnings.warn(
+                f"DataLoader: {reason}; falling back to fork workers. "
+                "Forking a process with a live JAX client can deadlock or "
+                "abort at shutdown — run from a real script file with the "
+                "dataset/collate_fn at module scope to enable spawn "
+                "workers.", RuntimeWarning, stacklevel=3)
+            self._mp_ctx = mp.get_context("fork")
+        return self._mp_ctx
+
+    @staticmethod
+    def _worker_child_env():
+        """Env overrides for worker children: workers only produce numpy
+        batches, so they must never initialize a TPU backend — strip the
+        axon tunnel registration (sitecustomize re-runs in spawned
+        children and can hang when the tunnel is down) and pin jax to
+        cpu in case anything imports it."""
+        return {"PALLAS_AXON_POOL_IPS": None, "AXON_POOL_SVC_OVERRIDE": None,
+                "JAX_PLATFORMS": "cpu"}
+
     def _iter_multi(self):
-        ctx = mp.get_context("fork")
+        import os as _os
+        ctx = self._start_context()
         index_queues = []
         data_queue = ctx.Queue()
         workers = []
@@ -209,29 +270,93 @@ class DataLoader:
                 except Exception:
                     shm = None
 
-        for wid in range(self.num_workers):
-            iq = ctx.Queue()
-            w = ctx.Process(
-                target=_worker_loop,
-                args=(self.dataset, iq, data_queue, self.collate_fn, wid,
-                      self.num_workers, base_seed, self.worker_init_fn,
-                      shm_cfg),
-                daemon=True)
-            w.start()
-            workers.append(w)
-            index_queues.append(iq)
+        # apply child-env overrides around start(): both fork and spawn
+        # children inherit os.environ as of start() time
+        saved_env = {}
+        for k, v in self._worker_child_env().items():
+            saved_env[k] = _os.environ.get(k)
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+        try:
+            for wid in range(self.num_workers):
+                iq = ctx.Queue()
+                w = ctx.Process(
+                    target=_worker_loop,
+                    args=(self.dataset, iq, data_queue, self.collate_fn, wid,
+                          self.num_workers, base_seed, self.worker_init_fn,
+                          shm_cfg),
+                    daemon=True)
+                w.start()
+                workers.append(w)
+                index_queues.append(iq)
+        finally:
+            for k, old in saved_env.items():
+                if old is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = old
 
         def recv():
-            if shm is not None:
-                import pickle
-                payload = pickle.loads(shm.get())
-                if isinstance(payload, tuple) and len(payload) == 2 \
-                        and payload[0] == "__big__":
-                    return data_queue.get(
-                        timeout=self.timeout if self.timeout else None)
-                return payload
-            return data_queue.get(
-                timeout=self.timeout if self.timeout else None)
+            # Poll with short sleeps instead of blocking indefinitely in
+            # the transport: a worker that died (bad __main__ under
+            # spawn, OOM-killed, segfault) must surface as an error, not
+            # an eternal hang on an empty queue. Reads next_yield/
+            # next_dispatch/reorder from the enclosing scope to decide
+            # whether a dead worker actually stalls the pipeline.
+            import time
+            deadline = (time.monotonic() + self.timeout) if self.timeout \
+                else None
+            wait = 1e-4
+            want_big = None  # batch id promised on data_queue via marker
+            while True:
+                if shm is None or want_big is not None:
+                    try:
+                        return data_queue.get(timeout=0.2)
+                    except queue_mod.Empty:
+                        pass
+                elif shm.qsize() > 0:
+                    import pickle
+                    payload = pickle.loads(shm.get())
+                    if isinstance(payload, tuple) and len(payload) == 2 \
+                            and payload[0] == "__big__":
+                        want_big = payload[1]
+                        continue
+                    return payload
+                dead = {i for i, w in enumerate(workers)
+                        if not w.is_alive()}
+                if dead:
+                    # stall = some batch we still need is owned by a dead
+                    # worker (round-robin: batch i -> worker i % N); an
+                    # idle worker dying after finishing its share must
+                    # not abort an epoch the others can complete
+                    if want_big is not None:
+                        stalled = (want_big % self.num_workers) in dead
+                    else:
+                        stalled = any(
+                            (i % self.num_workers) in dead
+                            for i in range(next_yield, next_dispatch)
+                            if i not in reorder)
+                    if stalled and (shm is None or shm.qsize() == 0):
+                        # grace drain: the dying worker may have flushed
+                        # its batch into the pipe first
+                        try:
+                            return data_queue.get(timeout=1.0)
+                        except queue_mod.Empty:
+                            dw = [workers[i] for i in sorted(dead)]
+                            raise RuntimeError(
+                                "DataLoader worker(s) "
+                                f"{[w.pid for w in dw]} exited unexpectedly "
+                                f"(exitcodes {[w.exitcode for w in dw]}) "
+                                "with batches still pending") from None
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"DataLoader timed out after {self.timeout}s "
+                        "waiting for a worker batch")
+                if shm is not None and want_big is None:
+                    time.sleep(wait)
+                    wait = min(wait * 2, 0.005)
 
         try:
             batches = list(self.batch_sampler)

@@ -102,9 +102,13 @@ def save(path: str):
 
 
 def load(path: str):
-    global _CACHE
+    """Merge a cache file into the in-memory cache. Deep-merge per op:
+    a file entry for an op must not discard shape keys already tuned in
+    this process (a shallow update would wholesale-replace the op's
+    inner dict)."""
     with open(path) as f:
-        _CACHE.update(json.load(f))
+        for op_name, entries in json.load(f).items():
+            _CACHE.setdefault(op_name, {}).update(entries)
 
 
 def time_callable(fn, args, warmup=1, iters=5):

@@ -53,10 +53,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     prunes the ProgramDesc to the feed/fetch subgraph; here the replay fn
     IS the pruned graph, with captured parameters frozen at save time).
     Loadable by load_inference_model / jit.load / inference.Predictor and
-    the native C serving ABI. Shapes export at the placeholders' build
-    shapes (dynamic dims as 1), matching jit.save's contract."""
+    the native C serving ABI. Placeholders declared with dynamic dims
+    (static.data('x', [None, 8])) export shape-polymorphic via
+    jax.export symbolic shapes, so the artifact serves any batch size;
+    if the program's ops cannot trace symbolically, falls back to the
+    concrete build shapes with a warning."""
     import os
     import pickle
+    import warnings
 
     import jax
     from jax import export as jexport
@@ -80,20 +84,65 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         outs = [env[id(t)] for t in fetch_vars]
         return outs[0] if len(outs) == 1 else tuple(outs)
 
-    abstract = [jax.ShapeDtypeStruct(t._array.shape, t._array.dtype)
-                for t in feed_vars]
-    exported = jexport.export(jax.jit(pure_forward))(
-        {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
-         for k, v in params.items()}, *abstract)
+    def _abstract(symbolic):
+        # One shared symbol per AXIS POSITION across all feeds (dim 0 of
+        # every dynamic feed is "_ax0" etc.): feeds that flow into the
+        # same op (x + y, input_ids vs labels) must agree on their
+        # dynamic sizes or tracing fails. All symbols come from ONE
+        # symbolic_shape call — per-dim calls create distinct symbolic
+        # scopes and jax.export refuses to mix them. Programs whose
+        # dynamic dims at the same axis are genuinely unrelated fall
+        # back to concrete shapes via the except path below.
+        dyn_specs = [getattr(t, "_data_spec", None) for t in feed_vars]
+        axes = sorted({i for s in dyn_specs if s is not None
+                       for i, d in enumerate(s) if d is None})
+        n_sym = len(axes)
+        if symbolic and n_sym:
+            syms = dict(zip(axes, jexport.symbolic_shape(
+                ",".join(f"_ax{i}" for i in axes))))
+        specs = []
+        for t, spec in zip(feed_vars, dyn_specs):
+            if symbolic and spec is not None and any(d is None for d in spec):
+                dims = tuple(syms[i] if d is None else d
+                             for i, d in enumerate(spec))
+                specs.append(jax.ShapeDtypeStruct(dims, t._array.dtype))
+            else:
+                specs.append(jax.ShapeDtypeStruct(t._array.shape,
+                                                  t._array.dtype))
+        return specs, n_sym
+
+    param_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                   for k, v in params.items()}
+    abstract, n_sym = _abstract(symbolic=True)
+    polymorphic = n_sym > 0
+    try:
+        exported = jexport.export(jax.jit(pure_forward))(
+            param_specs, *abstract)
+    except Exception as e:
+        if n_sym == 0:
+            raise
+        warnings.warn(
+            "save_inference_model: shape-polymorphic export of dynamic "
+            f"dims failed ({e}); exporting with the concrete build shapes "
+            "(dynamic dims baked as 1) — the artifact will only accept "
+            "that shape at serving time.", RuntimeWarning, stacklevel=2)
+        abstract, _ = _abstract(symbolic=False)
+        polymorphic = False
+        exported = jexport.export(jax.jit(pure_forward))(
+            param_specs, *abstract)
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
     fsave({k: Tensor(v) for k, v in params.items()},
           path_prefix + ".pdiparams")
     with open(path_prefix + ".meta", "wb") as f:
-        pickle.dump({"input_specs": [(list(t._array.shape),
-                                      str(t._array.dtype))
-                                     for t in feed_vars]}, f)
+        # the meta must describe what the artifact actually accepts: the
+        # dynamic spec only when the export really is shape-polymorphic,
+        # the baked concrete shapes after a fallback
+        pickle.dump({"input_specs": [
+            (list(getattr(t, "_data_spec", None) or t._array.shape)
+             if polymorphic else list(t._array.shape),
+             str(t._array.dtype)) for t in feed_vars]}, f)
     return path_prefix
 
 

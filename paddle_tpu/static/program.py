@@ -257,6 +257,10 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
     t = Tensor(jnp.zeros(concrete, convert_dtype(dtype)),
                stop_gradient=True)
     t.name = name
+    # original spec with dynamic dims preserved (None) — consumed by
+    # save_inference_model to export a shape-polymorphic artifact
+    t._data_spec = [None if (d is None or int(d) < 0) else int(d)
+                    for d in shape]
     prog = recording_program()
     if prog is None:
         raise RuntimeError(
@@ -306,6 +310,10 @@ class Executor:
         key = (len(program._ops), fetch_ids, train,
                len(program._state_writes),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays))
+        # the key contains the fetch tensors' id()s; id reuse after GC
+        # cannot alias a stale entry because the cached fn's closure
+        # (forward in _build) holds fetch_list alive for the entry's
+        # whole lifetime
         fn = program._cache.get(key)
         if fn is None:
             fn = self._build(program, feeds, caps, fetch_list, train)

@@ -14,6 +14,14 @@ pytestmark = pytest.mark.slow
 def _run(name, timeout=420):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Examples are CPU demos: strip the axon TPU-tunnel registration so the
+    # subprocess interpreter never loads the plugin (sitecustomize runs
+    # before the script body, so the script's own env.pop is too late for
+    # its parent process — and the plugin's background threads are what
+    # SIGABRT'd at exit in round 3).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("AXON_POOL_SVC_OVERRIDE", None)
+    env["JAX_PLATFORMS"] = "cpu"
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", name)],
         capture_output=True, text=True, env=env, timeout=timeout, cwd=REPO)

@@ -27,7 +27,7 @@ def test_shm_queue_cross_process():
     name = f"/ptq_ut_{os.getpid()}"
     q = native.ShmQueue(name, n_slots=4, slot_bytes=1 << 20, owner=True)
     try:
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context("spawn")
         p = ctx.Process(target=_producer, args=(name, 1 << 20, 4, 10))
         p.start()
         got = [pickle.loads(q.get()) for _ in range(10)]
@@ -83,7 +83,7 @@ def test_tcp_store_multiprocess():
     master = TCPStore("127.0.0.1", 0, is_master=True, world_size=3)
     assert master.is_native
     master.set("rank0", b"hello-0")
-    ctx = mp.get_context("fork")
+    ctx = mp.get_context("spawn")
     rq = ctx.Queue()
     procs = [ctx.Process(target=_store_worker,
                          args=(master.port, r, rq)) for r in (1, 2)]
@@ -103,21 +103,46 @@ def test_tcp_store_multiprocess():
     master.close()
 
 
+class _ModuleDS:
+    """Module-scope dataset: picklable, so the DataLoader uses spawn
+    workers (the default; fork of a live JAX client is only a fallback)."""
+
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        return np.full((8, 8), i, dtype=np.float32), np.int64(i)
+
+
 def test_dataloader_shm_transport():
-    import paddle_tpu as paddle
-    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.io import DataLoader
 
-    class DS(Dataset):
-        def __len__(self):
-            return 32
-
-        def __getitem__(self, i):
-            return np.full((8, 8), i, dtype=np.float32), np.int64(i)
-
-    dl = DataLoader(DS(), batch_size=4, num_workers=2,
+    dl = DataLoader(_ModuleDS(), batch_size=4, num_workers=2,
                     use_shared_memory=True)
+    assert isinstance(dl._start_context(), type(mp.get_context("spawn")))
     seen = []
     for img, label in dl:
         assert img.shape == [4, 8, 8]
         seen.extend(label.numpy().tolist())
     assert seen == list(range(32))
+
+
+def test_dataloader_fork_fallback_warns():
+    """A non-picklable payload (local class) selects fork workers with a
+    RuntimeWarning instead of crashing at spawn pickle time. Only the
+    start-method choice is asserted — actually forking the multithreaded
+    test process is exactly what the spawn default exists to avoid."""
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class LocalDS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((4,), i, dtype=np.float32)
+
+    dl = DataLoader(LocalDS(), batch_size=4, num_workers=1,
+                    use_shared_memory=False)
+    with pytest.warns(RuntimeWarning, match="not picklable"):
+        ctx = dl._start_context()
+    assert isinstance(ctx, type(mp.get_context("fork")))

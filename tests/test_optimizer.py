@@ -190,3 +190,20 @@ def test_amp_o2_decorate_master_weights():
     net2 = nn.Linear(4, 4)
     out = paddle.amp.decorate(net2, level="O1")
     assert out.weight._array.dtype == jnp.float32
+
+
+def test_amp_o2_keeps_norm_params_fp32():
+    """O2 decorate keeps normalization-layer scale/bias fp32 (reference:
+    amp_decorate keep_batch_norm_fp32) while other params go bf16."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(
+        nn.Linear(8, 16), nn.LayerNorm(16), nn.BatchNorm1D(16),
+        nn.Linear(16, 4))
+    net = paddle.amp.decorate(net, level="O2", dtype="bfloat16")
+    assert net[0].weight._array.dtype == jnp.bfloat16
+    assert net[3].weight._array.dtype == jnp.bfloat16
+    for norm in (net[1], net[2]):
+        for p in norm.parameters():
+            assert p._array.dtype == jnp.float32, norm

@@ -165,6 +165,59 @@ def test_save_load_inference_model_roundtrip(tmp_path):
     np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
 
 
+def test_save_inference_model_dynamic_batch(tmp_path):
+    """A placeholder with a None batch dim exports shape-polymorphic:
+    the artifact serves any batch size, not just the build shape
+    (reference: save_inference_model keeps -1 dims in the ProgramDesc)."""
+    main = static.Program()
+    with static.program_guard(main):
+        paddle.seed(7)
+        x = static.data("x", [None, 6])
+        h = static.nn.fc(x, 8, activation="relu")
+        out = static.nn.fc(h, 2)
+    exe = static.Executor()
+    prefix = str(tmp_path / "dyn_model")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    loaded = static.load_inference_model(prefix)
+    rng = np.random.default_rng(9)
+    for batch in (1, 3, 8):
+        xs = rng.standard_normal((batch, 6)).astype("float32")
+        ref, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        got = loaded(xs)
+        got = got.numpy() if hasattr(got, "numpy") else got[0].numpy()
+        assert got.shape == (batch, 2)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # meta records the original dynamic spec
+    import pickle
+    with open(prefix + ".meta", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["input_specs"][0][0] == [None, 6]
+
+
+def test_save_inference_model_two_dynamic_feeds(tmp_path):
+    """Two feeds with dynamic batch dims share one symbolic scope (a
+    per-dim symbolic_shape call would raise 'Invalid mixing of symbolic
+    scopes' at export)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6])
+        y = static.data("y", [None, 6])
+        out = x * 2.0 + y
+    exe = static.Executor()
+    prefix = str(tmp_path / "dyn2_model")
+    static.save_inference_model(prefix, [x, y], [out], exe, program=main)
+    loaded = static.load_inference_model(prefix)
+    rng = np.random.default_rng(11)
+    for batch in (2, 5):
+        xs = rng.standard_normal((batch, 6)).astype("float32")
+        ys = rng.standard_normal((batch, 6)).astype("float32")
+        got = loaded(xs, ys)
+        got = got.numpy() if hasattr(got, "numpy") else got[0].numpy()
+        np.testing.assert_allclose(got, xs * 2.0 + ys, rtol=1e-6)
+
+
 def test_batchnorm_running_stats_update_across_runs():
     """Recorded state-writes: BN running stats move with every
     Executor.run (reference: in-place updates on persistable variables),
