@@ -9,12 +9,36 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_LAST_FILE = os.path.join(_REPO, ".bench_last.json")
+_T0 = time.monotonic()
+
+
+def _log(msg):
+    sys.stderr.write(f"bench[{time.monotonic() - _T0:6.1f}s]: {msg}\n")
+    sys.stderr.flush()
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: repeat runs (and driver retries)
+    skip the multi-minute trace+compile of the 1B-param train step. Best
+    effort — the remote-compile tunnel may bypass it."""
+    try:
+        cache_dir = os.path.join(_REPO, ".jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:
+        _log(f"compilation cache unavailable: {e}")
 
 # peak bf16 TFLOP/s by device generation
 _PEAK_TFLOPS = {
@@ -40,7 +64,10 @@ def main():
     from paddle_tpu.models.llama import LlamaConfig, init_params, loss_fn
     import optax
 
+    _enable_compile_cache()
+    _log("initializing device backend")
     dev = jax.devices()[0]
+    _log(f"device ready: {getattr(dev, 'device_kind', dev)}")
     on_tpu = "tpu" in getattr(dev, "platform", "cpu").lower() or \
         "tpu" in getattr(dev, "device_kind", "").lower()
 
@@ -83,8 +110,9 @@ def main():
             tuned_blocks = pallas_ops.tune_causal_attention(
                 B=4, S=S, H=base["num_attention_heads"],
                 D=base["hidden_size"] // base["num_attention_heads"],
-                dtype=jnp.bfloat16, budget_s=300, iters=30, verbose=True)
-            sys.stderr.write(f"bench: tuned flash blocks {tuned_blocks}\n")
+                dtype=jnp.bfloat16, budget_s=120, iters=30, verbose=True)
+            _log(f"flash blocks: {tuned_blocks} (cache hit is instant; "
+                 "a live sweep is budgeted 120s)")
         except Exception as e:
             sys.stderr.write(f"bench: autotune skipped: {e}\n")
 
@@ -115,8 +143,10 @@ def main():
         # compile + warmup; scalar readback (not block_until_ready)
         # because the axon tunnel's block_until_ready does not reliably
         # fence execution
+        _log(f"compiling variant remat={policy} B={B}")
         params, opt_state, ce = step(params, opt_state, batch)
         float(ce)
+        _log("compile + warmup done; measuring")
 
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -174,7 +204,7 @@ def main():
     used_flash = pallas_ops.flash_attention_available(
         (B, S, cfg.num_attention_heads,
          cfg.hidden_size // cfg.num_attention_heads))
-    return {
+    result = {
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu, 2),
         "unit": "percent_mfu",
@@ -191,35 +221,66 @@ def main():
             "remat_policy": cfg.remat_policy if cfg.use_remat else "none",
         },
     }
+    if on_tpu:
+        # record for future _error_result fallbacks (committed when a
+        # real-chip run succeeds, so the provenance commit is the one
+        # that measured it)
+        try:
+            import subprocess
+            commit = subprocess.run(
+                ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+            with open(_LAST_FILE, "w") as f:
+                json.dump({"value": result["value"], "unit": result["unit"],
+                           "tokens_per_sec_per_chip":
+                               result["detail"]["tokens_per_sec_per_chip"],
+                           "note": f"{result['detail']['device']}, "
+                                   f"bench.py@{commit}"}, f, indent=1)
+        except Exception as e:
+            _log(f"could not write {_LAST_FILE}: {e}")
+    return result
 
 
 def _error_result(msg):
-    return {
+    out = {
         "metric": "llama_train_mfu_1chip",
         "value": 0.0,
         "unit": "percent_mfu",
         "vs_baseline": 0.0,
         "error": msg[-1500:] or "unknown",
-        # measured earlier on the same chip+code this round; see
-        # BASELINE.md "Recorded numbers" for the full table
-        "last_measured": {"value": 62.27, "unit": "percent_mfu",
-                          "tokens_per_sec_per_chip": 20037,
-                          "note": "TPU v5e, round 3, bench.py@726ddd7"},
     }
+    # last successful real-chip measurement, if one is recorded (written
+    # by a successful run and committed alongside the code it measured —
+    # never a hardcoded constant that outlives the code it described)
+    try:
+        with open(_LAST_FILE) as f:
+            out["last_measured"] = json.load(f)
+    except Exception:
+        pass
+    return out
 
 
 def run():
     """Never exit without the JSON line: a failed bench prints value 0.0
-    with the error attached, and a watchdog covers hangs (e.g. a dead TPU
-    tunnel blocking backend init) by printing the error record before the
-    driver's own timeout kills the process silently."""
-    import os
+    with the error attached, and a staged watchdog covers hangs by
+    printing the error record before the driver's own timeout kills the
+    process silently. Stage 1: device init must complete within
+    PADDLE_TPU_BENCH_DEVICE_TIMEOUT (a dead axon tunnel hangs
+    make_c_api_client forever — fail fast instead of burning the whole
+    budget; this was round 3's 0.0). Stage 2: the full measurement must
+    land within PADDLE_TPU_BENCH_TIMEOUT."""
     import threading
 
-    # default safely below typical 20-min outer driver timeouts so the
-    # watchdog's JSON line lands even when device init hangs
     timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1000"))
+    dev_timeout_s = float(
+        os.environ.get("PADDLE_TPU_BENCH_DEVICE_TIMEOUT", "240"))
     box = {}
+    device_ready = threading.Event()
+
+    def _probe_devices():
+        jax.devices()
+        device_ready.set()
 
     def _measure():
         try:
@@ -227,15 +288,26 @@ def run():
         except BaseException as e:  # noqa: BLE001 — the line must print
             box["result"] = _error_result(str(e) or repr(e))
 
+    # probe device init on its own thread so the measure thread never
+    # starts against a dead tunnel
+    p = threading.Thread(target=_probe_devices, daemon=True)
+    p.start()
+    if not device_ready.wait(dev_timeout_s):
+        print(json.dumps(_error_result(
+            f"device backend init did not complete within "
+            f"{dev_timeout_s:.0f}s (TPU tunnel down or unclaimable)")))
+        sys.stdout.flush()
+        os._exit(0)  # the hung init thread would block a clean exit
+
     t = threading.Thread(target=_measure, daemon=True)
     t.start()
     t.join(timeout_s)
     if t.is_alive():
         print(json.dumps(_error_result(
             f"bench timed out after {timeout_s:.0f}s "
-            "(device init or compile hang)")))
+            "(compile or execute hang)")))
         sys.stdout.flush()
-        os._exit(0)  # a hung backend thread would block a clean exit
+        os._exit(0)
     print(json.dumps(box["result"]))
     return 0
 
