@@ -85,6 +85,13 @@ class HybridTopology:
     def mp_degree(self):
         return self.dims["mp"]
 
+    @property
+    def batch_axes(self):
+        """Mesh axes the global batch shards over: with a carved-out
+        'sharding' (ZeRO) axis the data-parallel world is dp x sharding
+        (fleet: sharding ranks consume distinct batches too)."""
+        return ("dp", "sharding") if self.dims["sharding"] > 1 else "dp"
+
     def spec(self, *axes) -> PartitionSpec:
         return PartitionSpec(*axes)
 

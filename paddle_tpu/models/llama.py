@@ -473,16 +473,22 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     from ._sharding_utils import sharding_tree
     param_sh = sharding_tree(mesh, specs)
 
+    # ZeRO axis: the dedicated 'sharding' axis when the topology carves
+    # one out (fleet's 4-D ["data","pipe","sharding","model"]), else the
+    # data axis itself (pure-DP ZeRO)
+    zero_axis = "sharding" if topo.dims.get("sharding", 1) > 1 else "dp"
+    zero_degree = topo.dims.get(zero_axis, 1)
+
     def zero_shard_spec(spec, shape):
         # ZeRO-1: shard the largest unsharded dim of each optimizer-state
-        # array over 'dp' when divisible (distributed/sharding rationale)
+        # array over the zero axis when divisible
         dims = list(spec) + [None] * (len(shape) - len(spec))
-        if not zero or "dp" in dims or not shape:
+        if not zero or zero_axis in dims or not shape:
             return P(*dims) if dims else P()
-        n = topo.dp_degree
+        n = zero_degree
         for i, d in sorted(enumerate(shape), key=lambda t: -t[1]):
             if dims[i] is None and d % n == 0 and d >= n:
-                dims[i] = "dp"
+                dims[i] = zero_axis
                 break
         return P(*dims)
 
@@ -535,8 +541,9 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
         params = optax.apply_updates(params, updates)
         return params, opt_state, {"loss": total, "ce": ce}
 
-    batch_sh = {"input_ids": NamedSharding(mesh, P("dp", None)),
-                "labels": NamedSharding(mesh, P("dp", None))}
+    batch_axes = getattr(topo, "batch_axes", "dp")
+    batch_sh = {"input_ids": NamedSharding(mesh, P(batch_axes, None)),
+                "labels": NamedSharding(mesh, P(batch_axes, None))}
     step_jit = jax.jit(step, in_shardings=(param_sh, None, batch_sh),
                        out_shardings=(param_sh, None, None),
                        donate_argnums=(0, 1))

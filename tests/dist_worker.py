@@ -1,0 +1,141 @@
+"""Multi-process distributed trainer script.
+
+Launched as a real OS process gang by test_multiprocess_dist.py —
+the reference's workhorse pattern (test_dist_base.py:899,
+_run_cluster_nccl2:1558: spawn trainer subprocesses on local free ports,
+run the same model, assert loss parity between the gang and
+single-process execution).
+
+Flow per rank:
+  1. native TCPStore rendezvous — rank 0 publishes the jax coordination
+     service address (the NCCL-unique-id-exchange analog)
+  2. paddle_tpu.distributed.init_parallel_env -> jax.distributed.initialize
+  3. cross-process collectives: psum via GSPMD, all_gather via shard_map
+  4. 3 DP training steps (batch sharded over 'dp'); every rank checks
+     loss parity against the single-process reference it computes locally
+Prints one JSON result line prefixed RESULT: for the test to parse.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nprocs = int(os.environ["PADDLE_TRAINERS_NUM"])
+    store_port = int(os.environ["PTQ_STORE_PORT"])
+    coord_port = int(os.environ["PTQ_COORD_PORT"])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    # 1. rendezvous through the native TCPStore
+    from paddle_tpu.distributed.store import TCPStore
+    store = TCPStore("127.0.0.1", store_port, is_master=(rank == 0),
+                     world_size=nprocs)
+    if rank == 0:
+        store.set("jax_coordinator", f"127.0.0.1:{coord_port}".encode())
+    coord = store.wait("jax_coordinator").decode()
+    os.environ["PADDLE_MASTER"] = coord
+
+    # 2. gang bootstrap through the framework entry point
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()
+    assert jax.process_count() == nprocs, jax.process_count()
+    n_dev = len(jax.devices())
+    assert n_dev == nprocs, f"expected {nprocs} global devices, {n_dev}"
+
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    # 3a. all_reduce: each rank contributes rank+1; global sum must be
+    # N(N+1)/2, computed by a GSPMD psum across processes
+    local = np.array([rank + 1.0], np.float32)
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local)
+    total = float(jax.jit(jnp.sum)(x))
+    want = nprocs * (nprocs + 1) / 2.0
+    assert total == want, (total, want)
+
+    # 3b. all_gather through shard_map (the traced-collective mode of
+    # distributed.collective)
+    gathered = jax.jit(shard_map(
+        lambda v: lax.all_gather(v, "dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P(),
+        check_vma=False))(x)
+    got = np.asarray(gathered).reshape(-1).tolist()
+    assert got == [i + 1.0 for i in range(nprocs)], got
+
+    # 4. DP training: 3 steps of linear regression, batch sharded over
+    # 'dp'. Deterministic data from a shared seed; each rank owns rows
+    # [rank*per : (rank+1)*per]. Loss must match the single-process run.
+    rng = np.random.default_rng(0)
+    B, D = 4 * nprocs, 8
+    X = rng.standard_normal((B, D)).astype(np.float32)
+    w_true = rng.standard_normal((D, 1)).astype(np.float32)
+    Y = X @ w_true
+    w0 = np.zeros((D, 1), np.float32)
+    lr = 0.1
+
+    per = B // nprocs
+    batch_sh = NamedSharding(mesh, P("dp", None))
+    Xg = jax.make_array_from_process_local_data(
+        batch_sh, X[rank * per:(rank + 1) * per])
+    Yg = jax.make_array_from_process_local_data(
+        batch_sh, Y[rank * per:(rank + 1) * per])
+
+    @jax.jit
+    def step(w, xs, ys):
+        def loss_of(w):
+            return jnp.mean((xs @ w - ys) ** 2)
+        loss, g = jax.value_and_grad(loss_of)(w)
+        return w - lr * g, loss
+
+    w = jax.device_put(w0, NamedSharding(mesh, P(None, None)))
+    losses = []
+    for _ in range(3):
+        w, loss = step(w, Xg, Yg)
+        losses.append(float(loss))
+
+    # single-process reference (plain numpy, same math)
+    w_ref, ref_losses = w0.copy(), []
+    for _ in range(3):
+        pred = X @ w_ref
+        ref_losses.append(float(np.mean((pred - Y) ** 2)))
+        g = 2.0 * X.T @ (pred - Y) / B
+        w_ref = w_ref - lr * g
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+
+    print("RESULT:" + json.dumps({
+        "rank": rank, "world": nprocs, "allreduce": total,
+        "allgather": got, "losses": losses}), flush=True)
+    store.barrier("done")
+    # ordered teardown: clients must be gone before the coordinator
+    # (rank 0) exits — a client whose PollForError thread outlives the
+    # coordinator fails with "Socket closed" after all checks already
+    # passed. jax.distributed.shutdown() itself can barrier against the
+    # coordinator, so clients just exit; rank 0 waits for their notice.
+    if rank != 0:
+        store.set(f"exiting{rank}", b"1")
+        store.close()
+    else:
+        import time
+        for r in range(1, nprocs):
+            store.wait(f"exiting{r}")
+        time.sleep(1.0)  # let client sockets actually close
+        store.close()
+    # skip C++ static destructors: the coordination-service threads can
+    # abort at interpreter shutdown after the checks already passed
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
