@@ -1,48 +1,47 @@
-"""Parameter-server training: explicit scope-out (SURVEY §2.5 #10).
+"""Parameter-server-class training: host-RAM sharded embeddings.
 
-Reference: paddle/fluid/distributed/ps/ (~40k LoC: brpc-based
-PsService, DownpourBrpcPs tables, dense/sparse table shards, geo-async
-SGD) surfaced as fleet's ParameterServerOptimizer
-(python/paddle/distributed/fleet/meta_optimizers/ps_optimizer.py) and
-the CPU "heter" trainers.
+Reference: paddle/fluid/distributed/ps/ (~40k LoC: brpc-based PsService,
+sharded sparse tables `table/memory_sparse_table.cc`, dense/sparse
+pull/push, geo-async SGD) surfaced as fleet's ParameterServerOptimizer
+and the CPU "heter" trainers. Its purpose: train embedding tables that
+exceed accelerator memory, with sparse row-wise updates.
 
-Decision: OUT OF SCOPE for the TPU framework, by design rather than
-omission.
+TPU-native mapping — two regimes:
 
-Why:
-- The PS stack exists to scale sparse embedding tables beyond
-  accelerator memory on CPU clusters with asynchronous updates. On TPU
-  pods the same workload maps onto synchronous SPMD: embedding tables
-  shard over the mesh ('mp'/'dp' axes, e.g. models.llama vocab-parallel
-  embedding), lookups are XLA all-to-all/gather collectives over ICI,
-  and optimizer state shards with ZeRO (distributed/sharding). The
-  100B-feature / trillion-parameter claims the reference makes for PS
-  (README "Ultra-Large-Scale Training") are reached on TPU by adding
-  hosts to the mesh, not by a side channel of CPU parameter servers.
-- Asynchronous/geo-async SGD semantics conflict with the deterministic
-  synchronous step this framework compiles (one jit'd update over a
-  mesh); supporting them would fork the execution model for a hardware
-  profile (loose CPU clusters + RPC) that TPU deployments do not have.
-- The remaining PS use case — streaming recommender models with
-  out-of-accelerator-memory embeddings — needs a DCN-sharded embedding
-  service. That is deliverable as a separate service in front of this
-  framework (host-RAM embedding shards + device dense towers), and the
-  extension points it needs already exist: distributed.rpc for the
-  fetch/push plane and utils.cpp_extension's XLA FFI host ops for the
-  lookup kernels.
+- **Fits the pod**: shard the dense embedding over the mesh
+  ('mp'/'dp' axes, e.g. models.llama vocab-parallel embedding); lookups
+  are XLA collectives over ICI, optimizer state shards with ZeRO
+  (distributed/sharding). This is the default and the fast path.
+- **Exceeds accelerator memory** (recommender-scale sparse tables):
+  `HostEmbedding` here — delivered at `ps/host_embedding.py` — keeps
+  row-sharded tables in host RAM (locally, or on
+  `paddle_tpu.distributed.rpc` workers = the brpc PsService analog),
+  pulls only the touched rows to the device per step, and sparse-pushes
+  row gradients into a host-side row-wise optimizer (SGD/Adagrad),
+  matching the reference's asynchronous pull_sparse/push_sparse
+  contract (`memory_sparse_table.cc`).
 
-The symbols below raise with this explanation so fleet configs that
-request PS fail loudly with the migration path instead of silently
-training without it.
+The async/geo-async *dense* PS modes stay out of scope: synchronous
+SPMD over the mesh replaces them by construction — asynchronous dense
+updates would fork the execution model for a hardware profile (loose
+CPU clusters) that TPU deployments do not have.
+
+`ParameterServerOptimizer` (the fleet strategy face) still raises,
+pointing at the two supported regimes, so configs that request the
+reference's CPU-cluster PS topology fail loudly with the migration path.
 """
 from __future__ import annotations
 
-__all__ = ["ParameterServerOptimizer", "is_supported"]
+from .host_embedding import EmbeddingShard, HostEmbedding
 
-_MSG = ("parameter-server training is out of scope on the TPU stack: "
-        "shard embeddings over the mesh instead (see "
-        "paddle_tpu.distributed.ps docstring for the rationale and "
-        "migration path)")
+__all__ = ["ParameterServerOptimizer", "is_supported", "HostEmbedding",
+           "EmbeddingShard"]
+
+_MSG = ("the reference's CPU-cluster parameter-server topology is not "
+        "replicated on the TPU stack: shard dense embeddings over the "
+        "mesh, or use distributed.ps.HostEmbedding for tables that "
+        "exceed accelerator memory (see paddle_tpu.distributed.ps "
+        "docstring)")
 
 
 def is_supported() -> bool:

@@ -1,0 +1,317 @@
+"""Host-RAM sharded embedding service — the parameter-server replacement.
+
+Reference analog: paddle/fluid/distributed/ps/table/memory_sparse_table.cc
+(sharded host-memory embedding rows with row-wise optimizer state, pull/
+push RPC plane via brpc_ps_server.cc) and the heter-PS pull_sparse/
+push_sparse dense-tower pattern (framework/fleet/heter_ps/).
+
+TPU-native design: the table never enters HBM. Rows live in host RAM,
+row-sharded `id % n_shards` across shard holders that are either
+
+- **local** (default): numpy arrays in this process — the one-host case,
+  covering embeddings up to host-RAM size on a single machine; or
+- **rpc**: `EmbeddingShard`s hosted by `paddle_tpu.distributed.rpc`
+  workers (the brpc PsService analog) — host-RAM scale-out across the
+  pod's CPU side over DCN.
+
+Device integration is a `jax.custom_vjp` around `io_callback`: the
+forward looks up only the B x D rows the batch touches (pull_sparse),
+the backward sparse-pushes row gradients into the shard's row-wise
+optimizer (push_sparse; SGD or Adagrad, duplicate ids accumulated with
+np.add.at). Ordered callbacks keep step k's push before step k+1's pull.
+Updates are applied as the gradients arrive — the same asynchronous-SGD
+contract the reference PS trains recommenders with.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["EmbeddingShard", "HostEmbedding"]
+
+
+class EmbeddingShard:
+    """One host-RAM shard: global id g lives on shard g % n_shards at
+    local row g // n_shards (memory_sparse_table's shard_num layout)."""
+
+    def __init__(self, n_rows: int, dim: int, optimizer: str = "sgd",
+                 lr: float = 0.1, seed: int = 0, scale: float = 0.01,
+                 dtype=np.float32):
+        rng = np.random.default_rng(seed)
+        self.table = (rng.standard_normal((n_rows, dim)) * scale).astype(
+            dtype)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        if optimizer == "adagrad":
+            self._accum = np.zeros((n_rows, 1), np.float32)
+        elif optimizer != "sgd":
+            raise ValueError(
+                f"unknown row optimizer {optimizer!r}; expected 'sgd' or "
+                "'adagrad'")
+
+    @property
+    def nbytes(self) -> int:
+        n = self.table.nbytes
+        if self.optimizer == "adagrad":
+            n += self._accum.nbytes
+        return n
+
+    def lookup(self, rows: np.ndarray) -> np.ndarray:
+        return self.table[rows]
+
+    def push(self, rows: np.ndarray, grads: np.ndarray) -> None:
+        """Row-wise sparse update; duplicate ids accumulate first so one
+        batch touching a row twice applies one combined step."""
+        uniq, inv = np.unique(rows, return_inverse=True)
+        acc = np.zeros((uniq.shape[0], grads.shape[1]), np.float32)
+        np.add.at(acc, inv, grads.astype(np.float32))
+        if self.optimizer == "adagrad":
+            self._accum[uniq] += np.sum(acc * acc, axis=1, keepdims=True) \
+                / acc.shape[1]
+            step = acc / (np.sqrt(self._accum[uniq]) + 1e-8)
+        else:
+            step = acc
+        self.table[uniq] -= (self.lr * step).astype(self.table.dtype)
+
+    def state_dict(self):
+        sd = {"table": self.table, "optimizer": self.optimizer,
+              "lr": self.lr}
+        if self.optimizer == "adagrad":
+            sd["accum"] = self._accum
+        return sd
+
+    def load_state_dict(self, sd):
+        if sd.get("optimizer", self.optimizer) != self.optimizer:
+            raise ValueError(
+                f"checkpoint row optimizer {sd['optimizer']!r} does not "
+                f"match this shard's {self.optimizer!r}; construct the "
+                "shard with the checkpoint's optimizer to keep its "
+                "accumulator state meaningful")
+        self.table[...] = sd["table"]
+        if self.optimizer == "adagrad":
+            self._accum[...] = sd["accum"]
+
+
+# registry used by rpc shard holders: the rpc plane ships (fn, args), so
+# shard methods are addressed by key through these module-level functions
+_SHARDS: dict = {}
+
+
+def create_shard(key: str, n_rows: int, dim: int, **kw) -> int:
+    _SHARDS[key] = EmbeddingShard(n_rows, dim, **kw)
+    return n_rows
+
+
+def shard_lookup(key: str, rows: np.ndarray) -> np.ndarray:
+    return _SHARDS[key].lookup(rows)
+
+
+def shard_push(key: str, rows: np.ndarray, grads: np.ndarray) -> None:
+    _SHARDS[key].push(rows, grads)
+
+
+def shard_nbytes(key: str) -> int:
+    return _SHARDS[key].nbytes
+
+
+class HostEmbedding:
+    """Sharded host-RAM embedding with device-side lookup/push.
+
+    Use inside jitted steps or eager autograd: ``emb(ids)`` returns the
+    looked-up rows and its backward pushes sparse row gradients into the
+    host optimizer. ``device_budget_bytes`` documents the intent: the
+    table may exceed accelerator memory arbitrarily — only the touched
+    rows ever transfer.
+
+    rpc mode: pass ``rpc_workers=[name, ...]`` after
+    ``distributed.rpc.init_rpc`` — shard i lives on worker i % len,
+    created remotely; lookups/pushes ride ``rpc_sync``.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 n_shards: int = 1, optimizer: str = "sgd", lr: float = 0.1,
+                 seed: int = 0, dtype=np.float32,
+                 rpc_workers: Optional[List[str]] = None,
+                 device_budget_bytes: Optional[int] = None,
+                 name: str = "host_embedding"):
+        import jax
+
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.n_shards = int(n_shards)
+        self.dtype = np.dtype(dtype)
+        self.name = name
+        self._rpc_workers = list(rpc_workers) if rpc_workers else None
+        rows_per = [len(range(s, self.num_embeddings, self.n_shards))
+                    for s in range(self.n_shards)]
+        self._local: List[Optional[EmbeddingShard]] = []
+        if self._rpc_workers is None:
+            for s in range(self.n_shards):
+                self._local.append(EmbeddingShard(
+                    rows_per[s], embedding_dim, optimizer=optimizer, lr=lr,
+                    seed=seed + s, dtype=self.dtype))
+        else:
+            from .. import rpc
+            for s in range(self.n_shards):
+                w = self._rpc_workers[s % len(self._rpc_workers)]
+                rpc.rpc_sync(w, create_shard, args=(
+                    f"{name}/shard{s}", rows_per[s], embedding_dim),
+                    kwargs=dict(optimizer=optimizer, lr=lr, seed=seed + s,
+                                dtype=self.dtype))
+        if device_budget_bytes is not None \
+                and self.table_nbytes <= device_budget_bytes:
+            import warnings
+            warnings.warn(
+                f"HostEmbedding {name!r}: table ({self.table_nbytes} B) "
+                f"fits the device budget ({device_budget_bytes} B); a "
+                "mesh-sharded dense embedding (models vocab-parallel "
+                "embedding) would be faster", stacklevel=2)
+        self._fn = self._build_fn()
+
+    # -- shard plane --------------------------------------------------------
+    _RPC_FNS = {"lookup": shard_lookup, "push": shard_push,
+                "nbytes": shard_nbytes}
+
+    def _shard_call(self, s: int, method: str, *args):
+        if self._rpc_workers is None:
+            attr = getattr(self._local[s], method)
+            return attr(*args) if callable(attr) else attr  # nbytes: prop
+        from .. import rpc
+        w = self._rpc_workers[s % len(self._rpc_workers)]
+        return rpc.rpc_sync(w, self._RPC_FNS[method],
+                            args=(f"{self.name}/shard{s}", *args))
+
+    @property
+    def table_nbytes(self) -> int:
+        return sum(self._shard_call(s, "nbytes")
+                   for s in range(self.n_shards))
+
+    def _check_ids(self, ids: np.ndarray) -> None:
+        # numpy's wraparound indexing would silently serve (and on push,
+        # corrupt) an unrelated row for a bad id; error like the dense
+        # embedding's bounds contract instead
+        bad = (ids < 0) | (ids >= self.num_embeddings)
+        if bad.any():
+            raise IndexError(
+                f"HostEmbedding {self.name!r}: ids out of range "
+                f"[0, {self.num_embeddings}): "
+                f"{np.unique(ids[bad])[:10].tolist()}")
+
+    def _host_lookup(self, flat_ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(flat_ids, np.int64)
+        self._check_ids(ids)
+        out = np.empty((ids.shape[0], self.embedding_dim), self.dtype)
+        sid = ids % self.n_shards
+        for s in range(self.n_shards):
+            mask = sid == s
+            if not mask.any():
+                continue
+            out[mask] = self._shard_call(s, "lookup",
+                                         ids[mask] // self.n_shards)
+        return out
+
+    def _host_push(self, flat_ids: np.ndarray, grads: np.ndarray) -> None:
+        ids = np.asarray(flat_ids, np.int64)
+        self._check_ids(ids)
+        g = np.asarray(grads)
+        sid = ids % self.n_shards
+        for s in range(self.n_shards):
+            mask = sid == s
+            if not mask.any():
+                continue
+            self._shard_call(s, "push", ids[mask] // self.n_shards,
+                             g[mask])
+
+    # -- explicit pull/push (the reference's pull_sparse/push_sparse) -------
+    def pull_sparse(self, ids) -> np.ndarray:
+        ids = np.asarray(ids)
+        out = self._host_lookup(ids.reshape(-1))
+        return out.reshape(tuple(ids.shape) + (self.embedding_dim,))
+
+    def push_sparse(self, ids, grads) -> None:
+        ids = np.asarray(ids)
+        self._host_push(ids.reshape(-1),
+                        np.asarray(grads).reshape(-1, self.embedding_dim))
+
+    # -- device plane -------------------------------------------------------
+    def _build_fn(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import io_callback
+
+        dim = self.embedding_dim
+        jdtype = jnp.dtype(self.dtype)
+
+        # The lookup is custom_vjp'd over (ids, token). ids are integers
+        # (no cotangent); `token` is a differentiable scalar the caller
+        # threads through their param tree — autodiff only invokes a
+        # custom_vjp whose inputs are on the differentiation path, so the
+        # token is what makes the backward (the sparse push) fire inside
+        # grad-of-loss-wrt-params. Its own gradient is zero.
+        @jax.custom_vjp
+        def lookup(ids, token):
+            flat = ids.reshape(-1)
+            out = io_callback(
+                self._host_lookup,
+                jax.ShapeDtypeStruct((flat.shape[0], dim), jdtype),
+                flat, ordered=True)
+            del token  # participates in autodiff, not in the value
+            return out.reshape(tuple(ids.shape) + (dim,))
+
+        def fwd(ids, token):
+            return lookup(ids, token), (ids, token)
+
+        def bwd(res, g):
+            ids, token = res
+            flat = ids.reshape(-1)
+            gf = g.reshape((-1, dim))
+            io_callback(self._host_push, None, flat, gf, ordered=True)
+            return (np.zeros(ids.shape, jax.dtypes.float0),
+                    jnp.zeros_like(token))
+
+        lookup.defvjp(fwd, bwd)
+        return lookup
+
+    def init_token(self):
+        """Differentiable scalar to place in the training-step param tree
+        and pass to ``__call__`` — see _build_fn. Gradient is always 0,
+        so any optimizer leaves it at 1."""
+        import jax.numpy as jnp
+        return jnp.ones((), jnp.float32)
+
+    def __call__(self, ids, token=None):
+        from ...core.tensor import Tensor, apply_op
+        if isinstance(ids, Tensor):
+            if token is None:
+                if not hasattr(self, "_eager_token"):
+                    self._eager_token = Tensor(self.init_token(),
+                                               stop_gradient=False)
+                token = self._eager_token
+            # token requires grad -> the tape records this op and eager
+            # backward() reaches the vjp whose side effect is the push
+            return apply_op(self._fn, ids, token,
+                            op_name="host_embedding_lookup")
+        if token is None:
+            raise ValueError(
+                "HostEmbedding under jit needs the token: include "
+                "emb.init_token() in the params you differentiate and "
+                "pass it as emb(ids, token) — without it autodiff never "
+                "invokes the backward that pushes the row gradients")
+        return self._fn(ids, token)
+
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self):
+        if self._rpc_workers is not None:
+            raise NotImplementedError(
+                "rpc-mode checkpoint: call state_dict on the shard "
+                "holders (EmbeddingShard.state_dict) per worker")
+        return {f"shard{s}": self._local[s].state_dict()
+                for s in range(self.n_shards)}
+
+    def load_state_dict(self, sd):
+        if self._rpc_workers is not None:
+            raise NotImplementedError(
+                "rpc-mode checkpoint: load on the shard holders")
+        for s in range(self.n_shards):
+            self._local[s].load_state_dict(sd[f"shard{s}"])
