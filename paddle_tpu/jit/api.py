@@ -70,15 +70,92 @@ class StaticFunction:
                     f"{getattr(fn, '__qualname__', fn)}: {e!r}; "
                     "falling back to plain tracing")
 
+        self._converted_fn = fn
+        self._donate_argnums = donate_argnums
+        self._jit_cache: Dict[Any, Any] = {}
+
         def array_fn(*arrays, **kw):
             tensors = _tree_to_tensors(arrays)
             out = fn(*tensors, **kw)
             return _tree_to_arrays(out)
+        # kept for concrete_program/back-compat; __call__ uses the
+        # static-partitioned cache below
         self._jitted = jax.jit(array_fn, donate_argnums=donate_argnums)
 
+    @staticmethod
+    def _is_dynamic_leaf(x):
+        return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
     def __call__(self, *args, **kwargs):
-        arrays = _tree_to_arrays(args)
-        out = self._jitted(*arrays, **kwargs)
+        """Trace tensor/array leaves; keep every other leaf static.
+
+        Reference semantics: dy2static traces *tensors* into the
+        program — python scalars/bools/containers are build-time values
+        (a `for i in range(n)` with python n unrolls; a python bool
+        branches in python). Tracing them (what a bare jax.jit of all
+        args would do) both diverges from that contract and breaks
+        branches whose arms differ in shape per mode. Implementation:
+        partition the (args, kwargs) pytree, jit a closure over the
+        static leaves, cache per (treedef, static leaves).
+        """
+        is_tensor_leaf = lambda x: isinstance(x, Tensor)  # noqa: E731
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=is_tensor_leaf)
+        dyn_set = {i for i, leaf in enumerate(flat)
+                   if self._is_dynamic_leaf(leaf)}
+        dyn_idx = tuple(sorted(dyn_set))
+        # type(leaf) in the key: True/1/1.0 compare equal but must not
+        # share a baked closure
+        static_leaves = tuple((i, type(leaf), leaf)
+                              for i, leaf in enumerate(flat)
+                              if i not in dyn_set)
+        try:
+            key = (treedef, dyn_idx,
+                   tuple((i, t) for i, t, _ in static_leaves),
+                   tuple(leaf for _, _, leaf in static_leaves))
+            hash(key)
+        except TypeError:
+            # unhashable static leaf: no caching, direct trace each call
+            key = None
+        jitted = self._jit_cache.get(key) if key is not None else None
+        if jitted is None:
+            fn = self._converted_fn
+            n_leaves = len(flat)
+
+            # donate_argnums name TOP-LEVEL positional args; remap them
+            # to the positions of those args' dynamic leaves in the
+            # compacted call signature
+            donate = ()
+            if self._donate_argnums:
+                spans = []
+                pos = 0
+                for a in args:
+                    n = len(jax.tree_util.tree_flatten(
+                        a, is_leaf=is_tensor_leaf)[0])
+                    spans.append(range(pos, pos + n))
+                    pos += n
+                donated_flat = {i for j in self._donate_argnums
+                                if j < len(spans) for i in spans[j]}
+                donate = tuple(k for k, i in enumerate(dyn_idx)
+                               if i in donated_flat)
+
+            def call_with_static(*dyn_arrays):
+                # only sizes/static values are captured — never the
+                # caller's Tensors (they would pin device buffers in
+                # this cache entry for the StaticFunction's lifetime)
+                full = [None] * n_leaves
+                for i, _t, st in static_leaves:
+                    full[i] = st
+                for i, a in zip(dyn_idx, dyn_arrays):
+                    full[i] = Tensor(a)
+                a2, k2 = jax.tree_util.tree_unflatten(treedef, full)
+                return _tree_to_arrays(fn(*a2, **k2))
+
+            jitted = jax.jit(call_with_static, donate_argnums=donate)
+            if key is not None:
+                self._jit_cache[key] = jitted
+        dyn_arrays = [_as_array(flat[i]) for i in dyn_idx]
+        out = jitted(*dyn_arrays)
         return _tree_to_tensors(out)
 
     @property

@@ -25,9 +25,22 @@ supported patterns):
 - `break`/`continue` in converted loops (lowered to carried flags with
   guarded tails — the reference break_continue_transformer strategy);
 - branch/loop bodies that assign plain names (tuple targets ok);
-- `return`/`yield` INSIDE a converted block are not rewritten —
-  functions containing them in tensor-predicated blocks keep python
-  semantics and will raise jax's loud tracer error;
+- early `return` inside `if` chains (the reference return_transformer):
+  returns lower to a single return-value name with the trailing
+  statements duplicated into the non-returning paths, so a
+  tensor-predicated `if ...: return a` threads through lax.cond; every
+  path must then return values of one pytree structure. `return` inside
+  a converted LOOP stays unsupported (the loop is left as python);
+- `for` over tensors / enumerate / zip keeps python semantics and
+  unrolls at trace time (Tensor.__iter__ yields rows — the reference's
+  for-over-tensor contract on static shapes);
+- python list `append`/`extend` in loops works while the loop unrolls
+  (concrete bounds); a loop that goes traced while mutating a python
+  container raises a clear error naming the container and the
+  create_array/array_write alternative (list_transformer's TensorArray
+  role);
+- `yield` is not supported in converted blocks — functions containing
+  it keep python semantics;
 - unsupported shapes of code (no retrievable source, lambdas, already-
   transformed callables) fall back to plain tracing, like the
   reference's ast fallback path.
@@ -122,18 +135,32 @@ def convert_flag_off(flag):
     return 0 if bool(getattr(flag, "_array", flag)) else 1
 
 
-def convert_while_loop(cond_fn, body_fn, vals):
+def convert_while_loop(cond_fn, body_fn, vals, mutates=()):
     """Runtime dispatch for a rewritten `while`. The probe can turn
     traced MID-loop (a concrete range bound with a tensor-predicated
     break: the first iterations run eagerly until the lax.cond makes the
     flag a tracer) — re-dispatch to the traced path with the current
-    carry when that happens."""
+    carry when that happens.
+
+    mutates: names of python containers the body mutates in place
+    (lst.append(...)): legal while the loop unrolls eagerly, impossible
+    once it lowers to lax.while_loop (one trace of the body would run
+    the mutation once, silently losing every later iteration's element)
+    — raise the clear error the reference solves with TensorArray."""
     probe = cond_fn(*vals)
     while not _is_traced(probe):
         if not bool(getattr(probe, "_array", probe)):
             return vals
         vals = body_fn(*vals)
         probe = cond_fn(*vals)
+    if mutates:
+        raise ValueError(
+            "dy2static: a tensor-predicated while mutates python "
+            f"container(s) {list(mutates)}; list operations cannot be "
+            "carried through lax.while_loop — preallocate with "
+            "paddle.tensor.create_array/array_write (concrete size), "
+            "use a stacked tensor carry, or keep the loop bound "
+            "concrete so the loop unrolls")
     if any(v is UNDEFINED for v in vals):
         raise ValueError(
             "dy2static: a loop variable of a tensor-predicated `while` "
@@ -219,6 +246,103 @@ def _has_blocker(stmts) -> bool:
     return False
 
 
+def _any_return(stmts) -> bool:
+    """Return statements in this suite or nested `if` chains (loops and
+    nested function scopes are opaque: their returns are handled by
+    python directly / belong to the inner function)."""
+    for st in stmts:
+        if isinstance(st, ast.Return):
+            return True
+        if isinstance(st, ast.If) and (_any_return(st.body)
+                                       or _any_return(st.orelse)):
+            return True
+    return False
+
+
+def _count_returning_ifs(stmts) -> int:
+    """How many if statements (recursively) contain an early return —
+    bounds the else-absorption duplication in _lower_returns."""
+    n = 0
+    for st in stmts:
+        if isinstance(st, ast.If):
+            if _any_return(st.body) or _any_return(st.orelse):
+                n += 1
+            n += _count_returning_ifs(st.body)
+            n += _count_returning_ifs(st.orelse)
+    return n
+
+
+def _return_in_ifs(stmts) -> bool:
+    # _any_return recurses into nested if chains, so one pass over the
+    # top-level statements sees every convertible early return
+    return any(isinstance(st, ast.If)
+               and (_any_return(st.body) or _any_return(st.orelse))
+               for st in stmts)
+
+
+def _lower_returns(body, val):
+    """Early-return lowering (reference: dy2static/return_transformer).
+
+    Every `return e` inside the function's `if` structure becomes
+    `<val> = e`, with the statements following a returning `if`
+    duplicated into its non-returning paths, so control always falls to
+    one final `return <val>` at the bottom. Paths that fall off the end
+    assign None, matching python. Loops are untouched: a `return` inside
+    them still exits the function directly (python semantics), which is
+    correct because the final return is only reached by falling through.
+    """
+    def process(seq):
+        out = []
+        for i, st in enumerate(seq):
+            if isinstance(st, ast.Return):
+                out.append(ast.Assign(
+                    targets=[ast.Name(id=val, ctx=ast.Store())],
+                    value=st.value or ast.Constant(value=None)))
+                return out  # anything after is unreachable
+            if isinstance(st, ast.If) and (_any_return(st.body)
+                                           or _any_return(st.orelse)):
+                rest = seq[i + 1:]
+                out.append(ast.If(
+                    test=st.test,
+                    body=process(list(st.body) + rest) or [ast.Pass()],
+                    orelse=process(list(st.orelse) + rest)))
+                return out
+            out.append(st)
+        # fell off the end of this path
+        out.append(ast.Assign(targets=[ast.Name(id=val, ctx=ast.Store())],
+                              value=ast.Constant(value=None)))
+        return out
+
+    new = process(list(body))
+    new.append(ast.Return(value=ast.Name(id=val, ctx=ast.Load())))
+    return new
+
+
+def _reads_in(nodes):
+    """Overapproximate set of names read anywhere in these nodes
+    (including nested scopes — a closure read keeps a name live)."""
+    reads = set()
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                reads.add(n.id)
+    return reads
+
+
+def _mutated_containers(stmts):
+    """Names whose in-place mutating methods are called in the block —
+    candidates that cannot ride a traced loop carry."""
+    muts = set()
+    for s in stmts:
+        for node in ast.walk(s):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("append", "extend", "insert")
+                    and isinstance(node.func.value, ast.Name)):
+                muts.add(node.func.value.id)
+    return sorted(muts)
+
+
 class _Rewriter(ast.NodeTransformer):
     """Rewrites if/while statements into helper calls with generated
     closures. Fresh names are prefixed __pt_ to stay out of user space.
@@ -233,6 +357,38 @@ class _Rewriter(ast.NodeTransformer):
         self.converted = 0  # actual conversions (fresh-name allocation
         # alone must not defeat the caller's keep-original fallback)
         self.global_names = set(global_names)
+        # liveness context: the set of names read after the statement
+        # being visited (None = unknown -> thread conservatively). Names
+        # assigned in a branch but never read later need not be threaded
+        # through lax.cond — crucial for early-return lowering, whose
+        # else-absorption creates branch-local locals that would
+        # otherwise trip the one-sided UNDEFINED check.
+        self._live = None
+
+    def _visit_block(self, stmts, live_after):
+        """Visit a suite giving each statement its reads-after set
+        (live_after=None propagates the conservative unknown)."""
+        out = []
+        prev_live = self._live
+        for i, st in enumerate(stmts):
+            self._live = None if live_after is None else (
+                _reads_in(stmts[i + 1:]) | live_after)
+            r = self.visit(st)
+            if isinstance(r, list):
+                out.extend(r)
+            elif r is not None:
+                out.append(r)
+        self._live = prev_live
+        return out
+
+    def visit_FunctionDef(self, node):
+        # each function scope gets its own liveness context; at the end
+        # of the suite nothing is live (returns read their value Names,
+        # which _reads_in sees)
+        node.body = self._visit_block(node.body, set())
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
     def _fresh(self, kind):
         self.counter += 1
@@ -406,27 +562,26 @@ class _Rewriter(ast.NodeTransformer):
         return {n for n in candidate
                 if n in defined and n not in must_carry}
 
-    def _revisit(self, stmts):
-        """Run freshly generated statements through the transformer —
-        Ifs that were unconvertible while they held a break/continue
-        become convertible after the lowering replaced those with flag
-        assignments."""
-        out = []
-        for st in stmts:
-            r = self.visit(st)
-            if isinstance(r, list):
-                out.extend(r)
-            elif r is not None:
-                out.append(r)
-        return out
-
     # -- transforms ----------------------------------------------------
     def visit_If(self, node):
-        self.generic_visit(node)
+        live = self._live
+        node.body = self._visit_block(node.body, live)
+        node.orelse = self._visit_block(node.orelse, live)
         if _has_blocker(node.body) or _has_blocker(node.orelse):
             return node
         names = _assigned_names(node.body + node.orelse)
-        if not names or any(n in self.global_names for n in names):
+        # the global check must see every assigned name — a dead-store
+        # global would be filtered from the carry below, but converting
+        # would still move its assignment into a closure scope where the
+        # missing `global` declaration makes it a local write
+        if any(n in self.global_names for n in names):
+            return node
+        if live is not None:
+            # branch-local names nothing ever reads again need not ride
+            # the lax.cond carry (and must not: assigned one-sided from
+            # an unbound start they would trip the UNDEFINED check)
+            names = [n for n in names if n in live]
+        if not names:
             return node
         self.converted += 1
         tname, fname = self._fresh("true"), self._fresh("false")
@@ -453,7 +608,12 @@ class _Rewriter(ast.NodeTransformer):
         traced loop bounds work. Other iterables keep python semantics —
         they unroll at trace time, which is correct for static
         containers."""
-        self.generic_visit(node)
+        live = self._live
+        # visit the suites in place FIRST: every bail below returns
+        # `node`, and nested conversions must survive the bail
+        inner_live = None if live is None else (live | _reads_in([node]))
+        node.body = self._visit_block(node.body, inner_live)
+        node.orelse = self._visit_block(node.orelse, inner_live)
         if (node.orelse
                 or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
@@ -461,6 +621,7 @@ class _Rewriter(ast.NodeTransformer):
                 or node.iter.func.id != "range"
                 or node.iter.keywords
                 or not 1 <= len(node.iter.args) <= 3):
+            # non-range loops keep python semantics (trace-time unroll)
             return node
         body_stmts = list(node.body)
         flag_pre: list = []
@@ -469,12 +630,14 @@ class _Rewriter(ast.NodeTransformer):
             # lower here (not in visit_While) so the index bump below
             # stays UNGUARDED: `continue` must still advance the loop var
             brk, cont = self._fresh("brk"), self._fresh("cont")
+            if inner_live is not None:
+                inner_live = inner_live | {brk, cont}
             body_stmts, _ = self._lower_loop_interrupts(body_stmts,
                                                         brk, cont)
             body_stmts = [ast.Assign(
                 targets=[ast.Name(id=cont, ctx=ast.Store())],
                 value=ast.Constant(value=False))] \
-                + self._revisit(body_stmts)
+                + self._visit_block(body_stmts, inner_live)
             flag_pre = [ast.Assign(
                 targets=[ast.Name(id=n, ctx=ast.Store())],
                 value=ast.Constant(value=False)) for n in (brk, cont)]
@@ -530,17 +693,27 @@ class _Rewriter(ast.NodeTransformer):
                                  else [lowered])
 
     def visit_While(self, node):
-        self.generic_visit(node)
+        live = self._live
+        inner_live = None if live is None else (live | _reads_in([node]))
+        # visit the suites in place FIRST: every bail below returns
+        # `node`, and nested conversions must survive the bail
+        node.body = self._visit_block(node.body, inner_live)
+        node.orelse = self._visit_block(node.orelse, inner_live)
         if node.orelse:
             return node
         work, pre = node, []
         if self._loop_interrupts_present(node.body):
             brk, cont = self._fresh("brk"), self._fresh("cont")
+            # the synthesized test/guards read the flags: they must stay
+            # live (and in the carry) even though the pre-lowering AST
+            # never mentions them
+            if inner_live is not None:
+                inner_live = inner_live | {brk, cont}
             lowered, _ = self._lower_loop_interrupts(node.body, brk, cont)
             body = [ast.Assign(
                 targets=[ast.Name(id=cont, ctx=ast.Store())],
                 value=ast.Constant(value=False))] \
-                + self._revisit(lowered)
+                + self._visit_block(lowered, inner_live)
             test = ast.Call(
                 func=ast.Name(id="__pt_and_not", ctx=ast.Load()),
                 args=[node.test, ast.Name(id=brk, ctx=ast.Load())],
@@ -559,7 +732,14 @@ class _Rewriter(ast.NodeTransformer):
                   if isinstance(n, ast.Name)
                   and isinstance(n.ctx, ast.Load)}
         names = [n for n in all_names if n not in local]
-        if not names or any(n in self.global_names for n in names):
+        if any(n in self.global_names for n in names):
+            return node  # see visit_If: globals must not enter closures
+        if inner_live is not None:
+            # dead stores (assigned, never read in the loop or after)
+            # stay out of the carry: unbound before the loop they would
+            # poison a traced carry with UNDEFINED seeds
+            names = [n for n in names if n in inner_live]
+        if not names:
             return node
         self.converted += 1
         cname, bname = self._fresh("cond"), self._fresh("body")
@@ -568,13 +748,17 @@ class _Rewriter(ast.NodeTransformer):
         cond_fn.body = [ast.Return(value=work.test)]
         stmts.append(cond_fn)
         stmts.append(self._make_fn(bname, names, work.body, names))
+        muts = _mutated_containers(work.body)
         call = ast.Call(
             func=ast.Name(id="__pt_convert_while", ctx=ast.Load()),
             args=[ast.Name(id=cname, ctx=ast.Load()),
                   ast.Name(id=bname, ctx=ast.Load()),
                   ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
                                   for n in names], ctx=ast.Load())],
-            keywords=[])
+            keywords=[ast.keyword(
+                arg="mutates",
+                value=ast.Tuple(elts=[ast.Constant(value=m) for m in muts],
+                                ctx=ast.Load()))] if muts else [])
         stmts.append(ast.Assign(targets=[self._unpack_target(names)],
                                 value=call))
         stmts.extend(self._cleanup_stmts(names))
@@ -639,9 +823,21 @@ def convert_to_static(fn: Callable) -> Callable:
         if isinstance(node, ast.Global):
             global_names.update(node.names)
 
+    # early-return lowering first: once returns inside if chains become
+    # assignments to one value name, the rewriter below can thread those
+    # ifs through lax.cond like any other branch assignment
+    # Guard-style returns (body returns immediately) duplicate nothing;
+    # the worst case (deep returns in BOTH arms) doubles the tail per
+    # returning if, so cap how many we absorb before falling back to
+    # unconverted (python) semantics for the whole function.
+    lowered_returns = False
+    if _return_in_ifs(fdef.body) and _count_returning_ifs(fdef.body) <= 8:
+        fdef.body = _lower_returns(fdef.body, "__pt_retval")
+        lowered_returns = True
+
     rewriter = _Rewriter(global_names)
     new_tree = rewriter.visit(tree)
-    if rewriter.converted == 0:
+    if rewriter.converted == 0 and not lowered_returns:
         return fn  # nothing converted — keep the original object
     ast.fix_missing_locations(new_tree)
 

@@ -96,14 +96,17 @@ def test_case_first_match_wins():
     assert float(out.numpy()) == 2.0
 
 
-def test_python_if_on_traced_tensor_raises():
-    """The documented tracing contract: data-dependent python `if` fails
-    loudly under to_static instead of silently picking a branch."""
+def test_python_if_with_early_return_converts():
+    """Since the return-transformer landed, a data-dependent python `if`
+    with early returns converts to lax.cond instead of failing (the
+    pre-round-4 contract raised here)."""
     @paddle.jit.to_static
     def f(x):
-        if x.sum() > 0:  # python bool on a tracer
+        if x.sum() > 0:
             return x
         return -x
 
-    with pytest.raises(Exception):
-        f(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [1.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [1.0])

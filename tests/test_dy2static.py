@@ -253,9 +253,9 @@ def test_elif_chain_no_branch_taken():
         return x
 
     conv = convert_to_static(f)
-    assert conv is not f
+    # y is a dead store: liveness analysis sees nothing to thread, so
+    # the function may come back unconverted — behavior is what matters
     x = paddle.to_tensor(np.array([5.0], np.float32))
-    # neither branch assigns y; y is never used — must not crash
     np.testing.assert_allclose(conv(x, False, False).numpy(), [5.0])
     np.testing.assert_allclose(conv(x, True, False).numpy(), [5.0])
 
@@ -423,3 +423,209 @@ def test_unconvertible_function_keeps_original_object():
         return s
 
     assert convert_to_static(with_try) is with_try
+
+
+# ---------------------------------------------------------------------------
+# round 4: early return, for-over-tensor/enumerate/zip, list containers
+# (reference: dy2static return_transformer.py, loop_transformer.py,
+# list_transformer.py + the dygraph_to_static golden-model tests)
+# ---------------------------------------------------------------------------
+
+def test_early_return_tensor_predicate():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2.0
+        return -x
+
+    pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(pos).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(neg).numpy(), [1.0, 2.0])
+
+
+def test_early_return_with_trailing_compute():
+    """Statements after the returning if run only on the fall-through
+    path (duplicated into the non-returning branch by the lowering)."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 10.0:
+            return x * 0.0
+        y = x + 1.0
+        if y.sum() > 0:
+            return y * y
+        return y - 1.0
+
+    t = lambda v: paddle.to_tensor(np.array(v, np.float32))  # noqa: E731
+    np.testing.assert_allclose(f(t([20.0])).numpy(), [0.0])
+    np.testing.assert_allclose(f(t([2.0])).numpy(), [9.0])
+    np.testing.assert_allclose(f(t([-5.0])).numpy(), [-5.0])
+
+
+def test_early_return_matches_eager():
+    def g(x):
+        if x.max() > 1.0:
+            return x / x.max()
+        s = x + 0.5
+        if s.min() < 0:
+            return s * 0.0
+        return s
+
+    converted = convert_to_static(g)
+    assert converted is not g
+    for v in ([3.0, 1.0], [0.2, 0.1], [-2.0, 0.3]):
+        x = paddle.to_tensor(np.array(v, np.float32))
+        np.testing.assert_allclose(converted(x).numpy(), g(x).numpy(),
+                                   rtol=1e-6)
+
+
+def test_early_return_none_fallthrough_concrete():
+    """Concrete predicates keep python's None fall-through."""
+    def g(flag, x):
+        if flag:
+            return x * 2.0
+        # falls off the end -> None
+
+    converted = convert_to_static(g)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(converted(True, x).numpy(), [2.0])
+    assert converted(False, x) is None
+
+
+def test_for_over_tensor_rows():
+    """for-over-tensor unrolls at trace time, row per iteration
+    (reference loop_transformer for-over-tensor on static shapes)."""
+    @paddle.jit.to_static
+    def f(m):
+        acc = paddle.zeros([3])
+        for row in m:
+            acc = acc + row * row
+        return acc
+
+    m = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    np.testing.assert_allclose(
+        f(m).numpy(), (m.numpy() ** 2).sum(axis=0), rtol=1e-6)
+
+
+def test_for_enumerate_and_zip():
+    @paddle.jit.to_static
+    def f(m, scales):
+        acc = paddle.zeros([3])
+        for i, row in enumerate(m):
+            acc = acc + row * float(i)
+        for row, s in zip(m, scales):
+            acc = acc + row * s
+        return acc
+
+    m_np = np.arange(12, dtype=np.float32).reshape(4, 3)
+    scales = [0.5, 1.0, 1.5, 2.0]
+    m = paddle.to_tensor(m_np)
+    want = sum(m_np[i] * i for i in range(4)) + \
+        sum(m_np[i] * scales[i] for i in range(4))
+    np.testing.assert_allclose(f(m, scales).numpy(), want, rtol=1e-6)
+
+
+def test_list_append_in_concrete_loop():
+    """list_transformer role: appends in loops that unroll work, and the
+    list concatenates like a TensorArray."""
+    @paddle.jit.to_static
+    def f(x):
+        outs = []
+        for i in range(3):  # concrete bound: the loop unrolls
+            outs.append(x * float(i + 1))
+        return paddle.stack(outs).sum(axis=0)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(f(x).numpy(), [6.0, 12.0])
+
+
+def test_list_append_in_traced_loop_raises_clearly():
+    @paddle.jit.to_static
+    def f(x, n):
+        outs = []
+        i = paddle.to_tensor(0)
+        while i < n:
+            outs.append(x * 2.0)
+            i = i + 1
+        return outs
+
+    with pytest.raises(ValueError, match="container.*outs|outs.*container"):
+        f(paddle.to_tensor(np.array([1.0], np.float32)),
+          paddle.to_tensor(3))
+
+
+def test_golden_model_containers_and_early_return():
+    """Golden-test style (dygraph_to_static/test_bert-ish): a Layer whose
+    forward mixes list appends, enumerate, and tensor-predicated early
+    return — translated matches eager on every path. The mode switch
+    rides as a static kwarg (different output shapes per mode); within a
+    mode, both arms of the traced early return keep one shape."""
+    import paddle_tpu.nn as nn
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fcs = nn.LayerList([nn.Linear(4, 4) for _ in range(3)])
+
+        def forward(self, x, collect_all=False):
+            feats = []
+            h = x
+            for i, fc in enumerate(self.fcs):
+                h = paddle.tanh(fc(h)) * float(i + 1)
+                feats.append(h)
+            if collect_all:
+                out = paddle.concat(feats, axis=-1)
+                if out.sum() > 0:
+                    return out * 2.0  # early exit, same shape as below
+                return out
+            if h.sum() > 0:
+                return h * 2.0
+            return h
+
+    paddle.seed(0)
+    net = Net()
+    rng = np.random.default_rng(0)
+    static_fwd = to_static(net.forward)
+    for shift in (2.0, -2.0):  # drive both sides of the traced return
+        x = paddle.to_tensor(
+            (rng.standard_normal((2, 4)) + shift).astype("float32"))
+        for mode in (True, False):
+            np.testing.assert_allclose(
+                static_fwd(x, collect_all=mode).numpy(),
+                net(x, collect_all=mode).numpy(), rtol=1e-5)
+
+
+def test_nested_if_converts_inside_unconvertible_loop():
+    """A while made unconvertible (return inside) must still get its
+    nested tensor-if converted in place (regression: the bail path once
+    discarded the visited body)."""
+    @paddle.jit.to_static
+    def f(x):
+        n = 0
+        while n < 3:
+            if x.sum() > 0:
+                x = x - 1.0
+            n = n + 1
+            if n == 3:
+                return x
+        return x
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([7.0], np.float32))).numpy(), [4.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([-7.0], np.float32))).numpy(), [-7.0])
+
+
+def test_static_leaf_type_distinguished():
+    """True and 1 are equal python values but must not share a compiled
+    closure (type participates in the static cache key)."""
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x, mode):
+        calls.append(type(mode))
+        return x * 2.0 if isinstance(mode, bool) else x * 3.0
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(f(x, True).numpy(), [2.0])
+    np.testing.assert_allclose(f(x, 1).numpy(), [3.0])
