@@ -19,15 +19,21 @@ from ..core.tensor import Tensor
 
 __all__ = ["save", "load"]
 
-_BF16_TAG = "__bf16__"
+_BF16_TAG = "__bf16__"          # legacy: float32-upcast payload
+_BF16_BITS_TAG = "__bf16_bits__"  # raw uint16 bit payload (half size)
 
 
 def _pack(obj):
     if isinstance(obj, Tensor):
         arr = np.asarray(obj._array)
         if obj._array.dtype == jnp.bfloat16:
-            return {_BF16_TAG: True,
-                    "data": np.asarray(obj._array.astype(jnp.float32))}
+            # raw 16-bit payload: exact, picklable without ml_dtypes,
+            # and half the bytes of the legacy float32 upcast. A NEW tag
+            # key, so a pre-bits reader sees an untagged dict (loud
+            # type/shape failure downstream) instead of silently
+            # interpreting bit patterns as float values.
+            return {_BF16_BITS_TAG: True,
+                    "data": np.asarray(obj._array).view(np.uint16)}
         return arr
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
@@ -38,7 +44,9 @@ def _pack(obj):
 
 def _unpack(obj):
     if isinstance(obj, dict):
-        if obj.get(_BF16_TAG):
+        if obj.get(_BF16_BITS_TAG):
+            return Tensor(jnp.asarray(obj["data"]).view(jnp.bfloat16))
+        if obj.get(_BF16_TAG):  # legacy float32-upcast encoding
             return Tensor(jnp.asarray(obj["data"]).astype(jnp.bfloat16))
         return {k: _unpack(v) for k, v in obj.items()}
     if isinstance(obj, np.ndarray):

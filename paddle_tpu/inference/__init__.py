@@ -38,7 +38,10 @@ import numpy as np
 
 from ..core.tensor import Tensor as _EagerTensor
 
-__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+from .convert import convert_to_mixed_precision  # noqa: E402
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
+           "convert_to_mixed_precision"]
 
 
 class Config:

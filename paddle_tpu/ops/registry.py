@@ -45,6 +45,23 @@ def _ensure_tensor(x):
     return to_tensor(x)
 
 
+def host_only_guard(op_name, *tensors, alternative=None):
+    """Host-side ops (dynamic output sizes, numpy compute — like the
+    reference's CPU detection/sampling kernels) cannot be traced into a
+    compiled program; fail with an actionable message instead of jax's
+    opaque TracerArrayConversionError at the np.asarray call."""
+    from jax.core import Tracer
+    for t in tensors:
+        arr = getattr(t, "_array", t)
+        if isinstance(arr, Tracer):
+            alt = f"; use {alternative} inside jit" if alternative else ""
+            raise TypeError(
+                f"{op_name} runs on the host (its output size is "
+                "data-dependent) and cannot be traced into a jit/"
+                f"to_static program{alt}. Call it eagerly on concrete "
+                "tensors, or move it outside the compiled section.")
+
+
 def unary_op(name: str, jfn: Callable, doc: str = ""):
     """Build + register a Tensor-level unary elementwise op from a jnp fn."""
     def op(x, name=None):  # noqa: A002 - paddle APIs take a `name` kwarg

@@ -89,7 +89,10 @@ class SparseCooTensor(Tensor):
         return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
 
     def values(self):
-        return Tensor(self._bcoo.data)
+        # sparse conv/pool outputs carry their autograd-taped values so
+        # loss.backward() through .values() reaches the conv kernel
+        vt = getattr(self, "_values_t", None)
+        return vt if vt is not None else Tensor(self._bcoo.data)
 
     def to_dense(self):
         return Tensor(self._bcoo.todense(), stop_gradient=self.stop_gradient)
@@ -171,14 +174,23 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                            stop_gradient=stop_gradient)
 
 
-def _sparse_unary(name, fn):
+def _sparse_unary(op_name, fn):
     def op(x, name=None):
+        from ..core.tensor import apply_op
         if isinstance(x, SparseCooTensor):
             b = x._bcoo
-            out = jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)
-            return SparseCooTensor(out, stop_gradient=x.stop_gradient)
+            # route through apply_op on the (possibly tape-linked)
+            # values so stacked sparse networks backprop through
+            # activations to lower conv layers
+            out_vals = apply_op(fn, x.values(),
+                                op_name=f"sparse_{op_name}")
+            out = jsparse.BCOO((out_vals._array, b.indices),
+                               shape=b.shape)
+            sp = SparseCooTensor(out, stop_gradient=out_vals.stop_gradient)
+            sp._values_t = out_vals
+            return sp
         return Tensor(fn(x._array))
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -397,4 +409,14 @@ class _SparseSoftmax:
 
 import types as _types  # noqa: E402
 
-nn = _types.SimpleNamespace(ReLU=_SparseReLU, Softmax=_SparseSoftmax)
+from . import conv as _conv  # noqa: E402
+
+nn = _types.SimpleNamespace(
+    ReLU=_SparseReLU, Softmax=_SparseSoftmax,
+    Conv3D=_conv.Conv3D, SubmConv3D=_conv.SubmConv3D,
+    Conv2D=_conv.Conv2D, SubmConv2D=_conv.SubmConv2D,
+    MaxPool3D=_conv.MaxPool3D,
+    functional=_types.SimpleNamespace(
+        conv3d=_conv.conv3d, subm_conv3d=_conv.subm_conv3d,
+        conv2d=_conv.conv2d, subm_conv2d=_conv.subm_conv2d,
+        max_pool3d=_conv.max_pool3d, relu=relu))

@@ -12,13 +12,17 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply_op
 from ..ops.registry import register, _ensure_tensor
 
-__all__ = ["nms", "box_iou", "roi_align", "deform_conv2d", "box_coder",
-           "prior_box", "yolo_box", "roi_pool", "psroi_pool", "matrix_nms",
-           "distribute_fpn_proposals", "generate_proposals",
+__all__ = ["nms", "nms_padded", "box_iou", "roi_align", "deform_conv2d",
+           "box_coder", "prior_box", "yolo_box", "roi_pool", "psroi_pool",
+           "matrix_nms", "distribute_fpn_proposals", "generate_proposals",
            "DeformConv2D"]
 
 
+from ..ops.registry import host_only_guard as _host_only  # noqa: E402
+
+
 def box_iou(boxes1, boxes2):
+    _host_only("box_iou", boxes1, boxes2)
     b1 = np.asarray(_ensure_tensor(boxes1)._array)
     b2 = np.asarray(_ensure_tensor(boxes2)._array)
     area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
@@ -34,6 +38,7 @@ def box_iou(boxes1, boxes2):
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
     """Greedy NMS — host-side (dynamic output), like the reference op."""
+    _host_only("nms", boxes, scores, alternative="nms_padded")
     b = np.asarray(_ensure_tensor(boxes)._array)
     if scores is None:
         s = np.ones(len(b), np.float32)
@@ -63,6 +68,55 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(jnp.asarray(keep))
 
 
+def nms_padded(boxes, scores, iou_threshold=0.3, max_out=None):
+    """Greedy NMS with a FIXED-SIZE output — the jit/TPU-compilable form.
+
+    Reference analog: the detection suite's nms with a static top-k
+    contract (operators/detection/nms_op + multiclass_nms keep_top_k).
+    Returns (keep_idx int32[max_out], valid bool[max_out]): the first
+    count(valid) entries are the kept box indices in score order;
+    padding entries have valid False. Same greedy-suppression order as
+    `nms`, but expressed as an argmax-select-suppress scan over a
+    precomputed IoU matrix — static shapes, compiles under jit and
+    shards like any dense op.
+    """
+    import jax
+    from jax import lax
+
+    b_arr = getattr(boxes, "_array", boxes)
+    s_arr = getattr(scores, "_array", scores)
+    n = b_arr.shape[0]
+    m = n if max_out is None else int(max_out)
+
+    def _impl(bx, sc):
+        bx = bx.astype(jnp.float32)
+        area = (bx[:, 2] - bx[:, 0]) * (bx[:, 3] - bx[:, 1])
+        lt = jnp.maximum(bx[:, None, :2], bx[None, :, :2])
+        rb = jnp.minimum(bx[:, None, 2:], bx[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+
+        neg = jnp.float32(-jnp.inf)
+
+        def step(work, _):
+            i = jnp.argmax(work)
+            valid = work[i] > neg
+            sup = jnp.where(valid & (iou[i] > iou_threshold), neg, work)
+            work = jnp.where(valid, sup.at[i].set(neg), work)
+            return work, (i.astype(jnp.int32), valid)
+
+        _, (idx, valid) = lax.scan(step, sc.astype(jnp.float32),
+                                   None, length=m)
+        return idx, valid
+
+    idx, valid = _impl(jnp.asarray(b_arr), jnp.asarray(s_arr))
+    if isinstance(boxes, Tensor) or isinstance(scores, Tensor):
+        return Tensor(idx), Tensor(valid)
+    return idx, valid
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     x = _ensure_tensor(x)
@@ -70,6 +124,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
+    _host_only("roi_align (boxes_num)", boxes_num)
     bn = np.asarray(_ensure_tensor(boxes_num)._array)
     batch_idx = np.repeat(np.arange(len(bn)), bn)
 
@@ -212,6 +267,7 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     operators/detection/box_coder_op). prior_box: [M, 4] (x1,y1,x2,y2);
     prior_box_var: [M, 4] | [4] | None; encode: target [N, 4] -> [N, M, 4];
     decode: target [N, M, 4] -> [N, M, 4]."""
+    _host_only("box_coder", prior_box, target_box, prior_box_var)
     pb = np.asarray(_ensure_tensor(prior_box)._array, np.float32)
     tb = np.asarray(_ensure_tensor(target_box)._array, np.float32)
     pbv = None if prior_box_var is None else \
@@ -317,6 +373,7 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
              scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
     """Decode YOLOv3 head output [N, P*(5+C), H, W] into boxes + scores
     (reference: operators/detection/yolo_box_op)."""
+    _host_only("yolo_box", x, img_size)
     xa = np.asarray(_ensure_tensor(x)._array, np.float32)
     imgs = np.asarray(_ensure_tensor(img_size)._array)
     N, _, H, W = xa.shape
@@ -370,6 +427,7 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
+    _host_only("roi_pool", x, boxes, boxes_num)
     feat = np.asarray(_ensure_tensor(x)._array, np.float32)
     bxs = np.asarray(_ensure_tensor(boxes)._array, np.float32)
     bn = np.asarray(_ensure_tensor(boxes_num)._array)
@@ -405,6 +463,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
+    _host_only("psroi_pool", x, boxes, boxes_num)
     feat = np.asarray(_ensure_tensor(x)._array, np.float32)
     bxs = np.asarray(_ensure_tensor(boxes)._array, np.float32)
     bn = np.asarray(_ensure_tensor(boxes_num)._array)
@@ -443,6 +502,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     """Matrix NMS (SOLOv2; reference: operators/detection/matrix_nms_op):
     parallel soft suppression by decayed IoU instead of greedy removal.
     bboxes [N, M, 4], scores [N, C, M]."""
+    _host_only("matrix_nms", bboxes, scores)
     bb = np.asarray(_ensure_tensor(bboxes)._array, np.float32)
     sc = np.asarray(_ensure_tensor(scores)._array, np.float32)
     N, C, M = sc.shape
@@ -513,6 +573,7 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     operators/detection/distribute_fpn_proposals_op). With ``rois_num``
     (per-image counts for a batched roi list) each level's count output
     is itself per-image."""
+    _host_only("distribute_fpn_proposals", fpn_rois)
     rois = np.asarray(_ensure_tensor(fpn_rois)._array, np.float32)
     off = 1.0 if pixel_offset else 0.0
     scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off)
@@ -550,6 +611,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     """RPN proposal generation: decode deltas at anchors, clip, filter
     small, NMS (reference: operators/detection/generate_proposals_v2_op).
     Single-image oriented; batches loop."""
+    _host_only("generate_proposals", scores, bbox_deltas, img_size)
     sc = np.asarray(_ensure_tensor(scores)._array, np.float32)
     bd = np.asarray(_ensure_tensor(bbox_deltas)._array, np.float32)
     imgs = np.asarray(_ensure_tensor(img_size)._array, np.float32)

@@ -300,3 +300,66 @@ def test_deform_conv2d_layer_and_grads():
     out.sum().backward()
     assert np.isfinite(np.asarray(off.grad._array)).all()
     assert layer.weight.grad is not None
+
+
+# ---------------------------------------------------------------------------
+# round 4: jittable fixed-size NMS + host-only trace guards
+# ---------------------------------------------------------------------------
+
+def test_nms_padded_matches_host_nms():
+    import jax
+    from paddle_tpu.vision.ops import nms, nms_padded
+
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(0, 90, (40, 2)).astype(np.float32)
+    wh = rng.uniform(5, 30, (40, 2)).astype(np.float32)
+    boxes = np.concatenate([xy, xy + wh], axis=1)
+    scores = rng.permutation(40).astype(np.float32)  # distinct scores
+
+    keep_ref = nms(paddle.to_tensor(boxes), 0.4,
+                   paddle.to_tensor(scores)).numpy()
+    idx, valid = nms_padded(paddle.to_tensor(boxes),
+                            paddle.to_tensor(scores), 0.4)
+    got = idx.numpy()[valid.numpy()]
+    np.testing.assert_array_equal(got, keep_ref)
+
+    # compiles under jit with static shapes, including a top-k cap
+    f = jax.jit(lambda b, s: nms_padded(b, s, 0.4, max_out=8))
+    idx_j, valid_j = f(boxes, scores)
+    np.testing.assert_array_equal(
+        np.asarray(idx_j)[np.asarray(valid_j)], keep_ref[:8])
+
+
+def test_nms_padded_all_suppressed_padding():
+    from paddle_tpu.vision.ops import nms_padded
+
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 10.5, 10.5],
+                      [0.5, 0.5, 9.5, 9.5]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    idx, valid = nms_padded(boxes, scores, 0.5)
+    assert np.asarray(valid).tolist() == [True, False, False]
+    assert int(np.asarray(idx)[0]) == 0
+
+
+def test_host_only_ops_raise_under_jit():
+    import jax
+    from paddle_tpu.vision.ops import nms, matrix_nms
+
+    boxes = np.zeros((4, 4), np.float32)
+
+    with pytest.raises(TypeError, match="nms_padded"):
+        jax.jit(lambda b: nms(b, 0.5))(boxes)
+    with pytest.raises(TypeError, match="host"):
+        jax.jit(lambda b, s: matrix_nms(b, s, 0.1))(
+            np.zeros((1, 4, 4), np.float32), np.zeros((1, 2, 4), np.float32))
+
+
+def test_sample_neighbors_raises_under_jit():
+    import jax
+    from paddle_tpu import geometric
+
+    row = np.array([0, 1, 2], np.int64)
+    colptr = np.array([0, 1, 2, 3], np.int64)
+    nodes = np.array([0, 1], np.int64)
+    with pytest.raises(TypeError, match="host"):
+        jax.jit(lambda r: geometric.sample_neighbors(r, colptr, nodes))(row)

@@ -1,0 +1,121 @@
+"""Post-export precision conversion for serving artifacts.
+
+Reference analog: convert_to_mixed_precision.cc pass tests + static
+post-training quantization tests — the saved model is transformed
+offline and served in lower precision within tolerance.
+"""
+import os
+import pickle
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.jit import InputSpec
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 8))
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([4, 32], "float32")])
+    x = np.random.default_rng(0).standard_normal((4, 32)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    return prefix, x, ref
+
+
+def _serve(prefix, x):
+    pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+    return pred.run([x])[0]
+
+
+def test_bf16_weights_roundtrip(saved_model, tmp_path):
+    prefix, x, ref = saved_model
+    dst = inference.convert_to_mixed_precision(
+        prefix, str(tmp_path / "m_bf16"), precision="bfloat16")
+    got = _serve(dst, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+    # weights payload shrinks (fp32 -> bf16)
+    assert os.path.getsize(dst + ".pdiparams") < \
+        0.75 * os.path.getsize(prefix + ".pdiparams")
+    with open(dst + ".meta", "rb") as f:
+        assert pickle.load(f)["precision"] == "bfloat16"
+
+
+def test_int8_weight_only_roundtrip(saved_model, tmp_path):
+    prefix, x, ref = saved_model
+    dst = inference.convert_to_mixed_precision(
+        prefix, str(tmp_path / "m_int8"), precision="int8")
+    got = _serve(dst, x)
+    # weight-only symmetric per-channel: a few percent on a 2-layer MLP
+    np.testing.assert_allclose(got, ref, rtol=6e-2, atol=6e-2)
+    assert os.path.getsize(dst + ".pdiparams") < \
+        0.5 * os.path.getsize(prefix + ".pdiparams")
+
+
+def test_int8_keeps_small_tensors_fp32(saved_model, tmp_path):
+    prefix, x, ref = saved_model
+    dst = inference.convert_to_mixed_precision(
+        prefix, str(tmp_path / "m_int8b"), precision="int8")
+    from paddle_tpu.framework.io import load as fload
+    payload = fload(dst + ".pdiparams")
+    q_keys = [k for k in payload if k.endswith("::q")]
+    assert q_keys, "matrices should be quantized"
+    import jax.numpy as jnp
+    for k, v in payload.items():
+        if k.endswith("::q"):
+            assert v._array.dtype == jnp.int8
+        elif not k.endswith("::scale"):
+            # biases and other small tensors untouched
+            assert v._array.dtype == jnp.float32
+            assert v._array.size < 1024
+
+
+def test_unknown_precision_raises(saved_model, tmp_path):
+    prefix, _, _ = saved_model
+    with pytest.raises(ValueError, match="precision"):
+        inference.convert_to_mixed_precision(
+            prefix, str(tmp_path / "x"), precision="int4")
+
+
+@pytest.mark.slow
+def test_c_host_serves_converted_artifact(tmp_path):
+    """The converted artifact keeps the jit.save format: the native C
+    serving host (libpaddle_tpu_capi) loads and runs it unchanged."""
+    from tests.test_capi_predictor import CAPI_SO, CSRC, HOST_C, REPO
+
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 64), nn.ReLU(), nn.Linear(64, 4))
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([1, 8], "float32")])
+    dst = inference.convert_to_mixed_precision(
+        prefix, str(tmp_path / "m_bf16"), precision="bfloat16")
+
+    if not os.path.exists(CAPI_SO):
+        subprocess.run(["make", "-C", CSRC, "capi"], check=True)
+    host_src = tmp_path / "host.c"
+    host_src.write_text(HOST_C)
+    host_bin = str(tmp_path / "host")
+    subprocess.run(
+        ["gcc", str(host_src), "-o", host_bin, f"-I{CSRC}",
+         f"-L{CSRC}", "-lpaddle_tpu_capi", f"-Wl,-rpath,{CSRC}"],
+        check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_CAPI_PLATFORM"] = "cpu"
+
+    x = np.random.default_rng(1).standard_normal((1, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy().reshape(-1)
+    x_file = tmp_path / "input.bin"
+    x_file.write_bytes(x.tobytes())
+    proc = subprocess.run([host_bin, dst, str(x_file)],
+                          capture_output=True, text=True, env=env,
+                          timeout=240)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    got = np.array([float(v) for v in proc.stdout.split()], np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
