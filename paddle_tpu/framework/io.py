@@ -32,8 +32,7 @@ def _pack(obj):
             # key, so a pre-bits reader sees an untagged dict (loud
             # type/shape failure downstream) instead of silently
             # interpreting bit patterns as float values.
-            return {_BF16_BITS_TAG: True,
-                    "data": np.asarray(obj._array).view(np.uint16)}
+            return {_BF16_BITS_TAG: True, "data": arr.view(np.uint16)}
         return arr
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}

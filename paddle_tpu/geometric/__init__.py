@@ -124,8 +124,8 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     reference's kernel is also dynamic-shaped CPU/GPU prep work, not a
     training-loop op; reference:
     python/paddle/geometric/sampling/neighbors.py sample_neighbors)."""
-    from ..vision.ops import _host_only
-    _host_only("geometric.sample_neighbors", row, colptr, input_nodes)
+    from ..ops.registry import host_only_guard
+    host_only_guard("geometric.sample_neighbors", row, colptr, input_nodes)
     import numpy as np
     rown = np.asarray(row._array if isinstance(row, Tensor) else row)
     colp = np.asarray(colptr._array if isinstance(colptr, Tensor)

@@ -121,12 +121,45 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
     if os.path.exists(meta_path):
         with open(meta_path, "rb") as f:
             meta = pickle.load(f)
-    in_specs = [jax.ShapeDtypeStruct(
-        [1 if d in (-1, None) else d for d in shape], np.dtype(dt))
-        for shape, dt in meta.get("input_specs", [])]
+    # keep the source artifact's shape polymorphism: dynamic dims
+    # re-export with ONE shared symbol per axis position (the
+    # save_inference_model rule); fall back to baked shapes — and a
+    # truthful meta — if the wrapper cannot trace symbolically
+    specs_meta = meta.get("input_specs", [])
+    dyn_axes = sorted({i for shape, _ in specs_meta
+                       for i, d in enumerate(shape) if d in (-1, None)})
+
+    def _in_specs(symbolic):
+        syms = {}
+        if symbolic and dyn_axes:
+            syms = dict(zip(dyn_axes, jexport.symbolic_shape(
+                ",".join(f"_ax{i}" for i in dyn_axes))))
+        out = []
+        for shape, dt in specs_meta:
+            dims = tuple(
+                (syms[i] if symbolic else 1) if d in (-1, None) else d
+                for i, d in enumerate(shape))
+            out.append(jax.ShapeDtypeStruct(dims, np.dtype(dt)))
+        return out
+
     param_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                    for k, v in new_params.items()}
-    re_exported = jexport.export(jax.jit(wrapped))(param_specs, *in_specs)
+    polymorphic = bool(dyn_axes)
+    try:
+        re_exported = jexport.export(jax.jit(wrapped))(
+            param_specs, *_in_specs(symbolic=True))
+    except Exception as e:
+        if not dyn_axes:
+            raise
+        import warnings
+        warnings.warn(
+            f"convert_to_mixed_precision: shape-polymorphic re-export "
+            f"failed ({e}); converting with dynamic dims baked as 1 — "
+            "the converted artifact only accepts that shape.",
+            RuntimeWarning, stacklevel=2)
+        polymorphic = False
+        re_exported = jexport.export(jax.jit(wrapped))(
+            param_specs, *_in_specs(symbolic=False))
 
     os.makedirs(os.path.dirname(dst_prefix) or ".", exist_ok=True)
     with open(dst_prefix + ".pdmodel", "wb") as f:
@@ -135,6 +168,11 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
           dst_prefix + ".pdiparams")
     meta = dict(meta)
     meta["precision"] = precision
+    if not polymorphic:
+        # meta must describe what the artifact actually accepts
+        meta["input_specs"] = [
+            ([1 if d in (-1, None) else d for d in shape], dt)
+            for shape, dt in specs_meta]
     with open(dst_prefix + ".meta", "wb") as f:
         pickle.dump(meta, f)
     return dst_prefix

@@ -90,9 +90,12 @@ class SparseCooTensor(Tensor):
 
     def values(self):
         # sparse conv/pool outputs carry their autograd-taped values so
-        # loss.backward() through .values() reaches the conv kernel
+        # loss.backward() through .values() reaches the conv kernel;
+        # the fallback must keep stop_gradient, or unary ops downstream
+        # silently stop recording gradients
         vt = getattr(self, "_values_t", None)
-        return vt if vt is not None else Tensor(self._bcoo.data)
+        return vt if vt is not None else Tensor(
+            self._bcoo.data, stop_gradient=self.stop_gradient)
 
     def to_dense(self):
         return Tensor(self._bcoo.todense(), stop_gradient=self.stop_gradient)

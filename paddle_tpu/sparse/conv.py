@@ -114,9 +114,7 @@ def _build_rulebook(idx, spatial, kernel, stride, padding, subm):
 
 
 def _as_value_tensor(x: SparseCooTensor) -> Tensor:
-    vt = getattr(x, "_values_t", None)
-    return vt if vt is not None else Tensor(x._bcoo.data,
-                                            stop_gradient=x.stop_gradient)
+    return x.values()  # tape-linked when the producer attached one
 
 
 def _coalesce_map(bcoo):
@@ -285,8 +283,14 @@ from ..nn.layer.layers import Layer  # noqa: E402
 
 class _ConvBase(Layer):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
-                 padding=0, dims=3, subm=False, bias_attr=None):
+                 padding=0, dims=3, subm=False, bias_attr=None,
+                 dilation=1, groups=1):
         super().__init__()
+        if _tuple(dilation, dims) != (1,) * dims or groups != 1:
+            # the functional forms enforce this; the Layer ctor must not
+            # silently compute a dilation-1/group-1 convolution instead
+            raise NotImplementedError(
+                f"{type(self).__name__}: dilation/groups must be 1")
         self._dims = dims
         self._subm = subm
         self._stride = stride
@@ -308,7 +312,8 @@ class Conv3D(_ConvBase):
                  padding=0, dilation=1, groups=1, padding_mode="zeros",
                  weight_attr=None, bias_attr=None, data_format="NDHWC"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
-                         padding, dims=3, subm=False, bias_attr=bias_attr)
+                         padding, dims=3, subm=False, bias_attr=bias_attr,
+                         dilation=dilation, groups=groups)
 
 
 class SubmConv3D(_ConvBase):
@@ -317,7 +322,8 @@ class SubmConv3D(_ConvBase):
                  key=None, weight_attr=None, bias_attr=None,
                  data_format="NDHWC"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
-                         padding, dims=3, subm=True, bias_attr=bias_attr)
+                         padding, dims=3, subm=True, bias_attr=bias_attr,
+                         dilation=dilation, groups=groups)
 
 
 class Conv2D(_ConvBase):
@@ -325,7 +331,8 @@ class Conv2D(_ConvBase):
                  padding=0, dilation=1, groups=1, padding_mode="zeros",
                  weight_attr=None, bias_attr=None, data_format="NHWC"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
-                         padding, dims=2, subm=False, bias_attr=bias_attr)
+                         padding, dims=2, subm=False, bias_attr=bias_attr,
+                         dilation=dilation, groups=groups)
 
 
 class SubmConv2D(_ConvBase):
@@ -334,7 +341,8 @@ class SubmConv2D(_ConvBase):
                  key=None, weight_attr=None, bias_attr=None,
                  data_format="NHWC"):
         super().__init__(in_channels, out_channels, kernel_size, stride,
-                         padding, dims=2, subm=True, bias_attr=bias_attr)
+                         padding, dims=2, subm=True, bias_attr=bias_attr,
+                         dilation=dilation, groups=groups)
 
 
 class MaxPool3D(Layer):

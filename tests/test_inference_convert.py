@@ -119,3 +119,30 @@ def test_c_host_serves_converted_artifact(tmp_path):
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     got = np.array([float(v) for v in proc.stdout.split()], np.float32)
     np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_convert_preserves_dynamic_batch(tmp_path):
+    """A shape-polymorphic artifact (static.save_inference_model with a
+    None batch dim) stays polymorphic through precision conversion."""
+    from paddle_tpu import static
+
+    main = static.Program()
+    paddle.enable_static()
+    with static.program_guard(main):
+        x = static.data("x", [None, 16])
+        out = static.nn.fc(x, 4, activation="relu")
+    exe = static.Executor()
+    prefix = str(tmp_path / "dyn")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    paddle.disable_static()
+
+    dst = inference.convert_to_mixed_precision(
+        prefix, str(tmp_path / "dyn_bf16"), precision="bfloat16")
+    pred = inference.create_predictor(inference.Config(dst + ".pdmodel"))
+    for batch in (2, 9):
+        o = pred.run([np.random.default_rng(batch).standard_normal(
+            (batch, 16)).astype(np.float32)])
+        assert o[0].shape == (batch, 4)
+    with open(dst + ".meta", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["input_specs"][0][0] == [None, 16]

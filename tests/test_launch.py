@@ -76,11 +76,14 @@ os._exit(9)
     assert "giving up" in proc.stderr
 
 
-def test_ps_scope_out_raises():
+def test_ps_strategy_points_at_host_embedding():
+    """The CPU-cluster PS topology stays unsupported, but the error now
+    routes users to the delivered HostEmbedding capability."""
     from paddle_tpu.distributed import ps
     assert not ps.is_supported()
-    with pytest.raises(NotImplementedError, match="out of scope"):
+    with pytest.raises(NotImplementedError, match="HostEmbedding"):
         ps.ParameterServerOptimizer()
+    assert hasattr(ps, "HostEmbedding")
 
 
 _SCRIPT_HANG_ONE = """

@@ -1,0 +1,39 @@
+"""Op-microbenchmark regression harness (tools/ci_op_benchmark.sh
+analog): measure -> record baseline -> gate."""
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_measure_record_check_cycle(tmp_path, monkeypatch):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import op_bench
+
+    monkeypatch.setattr(op_bench, "BASELINE",
+                        str(tmp_path / "baseline.json"))
+    ops = "layernorm_residual,embedding_gather"
+    assert op_bench.main(["--quick", "--record", "--ops", ops]) == 0
+    with open(op_bench.BASELINE) as f:
+        book = json.load(f)
+    (key,) = book.keys()
+    assert key.endswith("|quick")
+    assert set(book[key]) == {"layernorm_residual", "embedding_gather"}
+    assert all(v > 0 for v in book[key].values())
+
+    # same machine, immediately after: must pass the gate (generous
+    # threshold — tiny-shape CPU timings are noisy; the gate logic is
+    # what's under test, not this host's scheduler)
+    monkeypatch.setattr(op_bench, "THRESHOLD", 10.0)
+    assert op_bench.main(["--quick", "--check", "--ops", ops]) == 0
+
+    # a fabricated 100x-faster baseline must trip the gate
+    book[key] = {k: v / 100.0 for k, v in book[key].items()}
+    with open(op_bench.BASELINE, "w") as f:
+        json.dump(book, f)
+    assert op_bench.main(["--quick", "--check", "--ops", ops]) == 1

@@ -189,3 +189,21 @@ def test_stacked_sparse_net_backprops_through_relu():
     assert c2.weight.grad is not None
     assert c1.weight.grad is not None, "relu severed the tape"
     assert np.abs(np.asarray(c1.weight.grad._array)).sum() > 0
+
+
+def test_layer_rejects_dilation_and_groups():
+    with pytest.raises(NotImplementedError, match="dilation"):
+        sparse.nn.Conv3D(4, 8, 3, dilation=2)
+    with pytest.raises(NotImplementedError, match="dilation|groups"):
+        sparse.nn.SubmConv2D(4, 8, 3, groups=2)
+
+
+def test_unary_keeps_stop_gradient():
+    idx = np.array([[0, 0, 0, 0], [0, 1, 1, 1]], np.int64)
+    vals = np.array([[1.0], [-2.0]], np.float32)
+    x = sparse.sparse_coo_tensor(idx.T, vals, (1, 2, 2, 2, 1),
+                                 stop_gradient=False)
+    y = sparse.relu(x)
+    assert not y.stop_gradient
+    z = sparse.relu(sparse.sparse_coo_tensor(idx.T, vals, (1, 2, 2, 2, 1)))
+    assert z.stop_gradient

@@ -1,0 +1,187 @@
+"""Op microbenchmark harness with a regression gate.
+
+Reference analog: paddle/fluid/operators/benchmark/op_tester.cc (per-op
+latency measurement from config) + tools/ci_op_benchmark.sh (the CI
+gate that fails a PR when an op's time regresses against the recorded
+baseline).
+
+Usage:
+  python tools/op_bench.py                 # measure, print table
+  python tools/op_bench.py --record        # measure + write baseline
+  python tools/op_bench.py --check         # measure + fail on >25% regr.
+  python tools/op_bench.py --ops matmul,flash_attention
+
+Baselines are stored per device kind (a CPU number never gates a TPU
+run) in tools/op_bench_baseline.json. Timing uses the autotune module's
+chained-execution timer so the measurement is device compute, not
+host-transfer overhead.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "op_bench_baseline.json")
+# fail --check when slower than baseline * this (overridable for noisy
+# hosts / CI tiers)
+THRESHOLD = float(os.environ.get("PTQ_OP_BENCH_THRESHOLD", "1.25"))
+
+
+def _cases(quick=False):
+    """name -> (build() -> (fn, args)); shapes sized for one chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S = 512 if quick else 2048
+    B = 1 if quick else 4
+    H = 1024 if quick else 4096
+
+    def matmul():
+        k = jax.random.PRNGKey(0)
+        a = jax.random.normal(k, (H, H), jnp.bfloat16)
+        b = jax.random.normal(k, (H, H), jnp.bfloat16)
+        return jax.jit(lambda x, y: x @ y), (a, b)
+
+    def flash_attention():
+        from paddle_tpu.ops import pallas_ops
+        k = jax.random.split(jax.random.PRNGKey(0), 3)
+        d, heads = 128, 8
+        q, kk, v = (jax.random.normal(x, (B, S, heads, d), jnp.bfloat16)
+                    for x in k)  # [B, S, H, D] — causal_attention layout
+
+        def attn(q, k, v):
+            return pallas_ops.causal_attention(q, k, v)
+        return jax.jit(attn), (q, kk, v)
+
+    def layernorm_residual():
+        k = jax.random.PRNGKey(1)
+        x = jax.random.normal(k, (B * S, H), jnp.bfloat16)
+        g = jnp.ones((H,), jnp.float32)
+
+        def f(x, g):
+            m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+            v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+            return ((x - m) * jax.lax.rsqrt(v + 1e-6) * g).astype(x.dtype) + x
+        return jax.jit(f), (x, g)
+
+    def embedding_gather():
+        k = jax.random.PRNGKey(2)
+        table = jax.random.normal(k, (32000, H), jnp.bfloat16)
+        ids = jax.random.randint(k, (B * S,), 0, 32000)
+        return jax.jit(lambda t, i: t[i]), (table, ids)
+
+    def fused_adamw_update():
+        import optax
+        k = jax.random.PRNGKey(3)
+        p = {"w": jax.random.normal(k, (H, H), jnp.float32)}
+        opt = optax.adamw(1e-3)
+        st = opt.init(p)
+        g = {"w": jax.random.normal(k, (H, H), jnp.float32)}
+
+        @jax.jit
+        def upd(p, st, g):
+            u, st = opt.update(g, st, p)
+            return optax.apply_updates(p, u), st
+        return upd, (p, st, g)
+
+    def softmax_ce():
+        k = jax.random.PRNGKey(4)
+        logits = jax.random.normal(k, (B * S, 32000), jnp.float32)
+        labels = jax.random.randint(k, (B * S,), 0, 32000)
+
+        def f(lg, lb):
+            ls = jax.nn.log_softmax(lg)
+            return -jnp.mean(jnp.take_along_axis(ls, lb[:, None], 1))
+        return jax.jit(f), (logits, labels)
+
+    return {
+        "matmul_bf16": matmul,
+        "flash_attention": flash_attention,
+        "layernorm_residual": layernorm_residual,
+        "embedding_gather": embedding_gather,
+        "fused_adamw_update": fused_adamw_update,
+        "softmax_ce": softmax_ce,
+    }
+
+
+def measure(names=None, quick=False, iters=None):
+    import jax
+
+    from paddle_tpu.ops.autotune import time_callable
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    cases = _cases(quick=quick)
+    names = names or list(cases)
+    n_iter = iters or (2 if quick else 5)
+    out = {}
+    for name in names:
+        if name not in cases:
+            raise SystemExit(f"unknown op case {name!r}; "
+                             f"have {sorted(cases)}")
+        fn, args = cases[name]()
+        t = time_callable(fn, args, warmup=1, iters=n_iter)
+        out[name] = round(t * 1e3, 4)  # ms
+        print(f"{name:24s} {out[name]:10.3f} ms", flush=True)
+    return kind, out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record", action="store_true",
+                    help="write measurements as the new baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="fail (rc=1) on regression vs baseline")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated case subset")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes / fewer iters (harness smoke)")
+    args = ap.parse_args(argv)
+
+    names = args.ops.split(",") if args.ops else None
+    kind, results = measure(names, quick=args.quick)
+
+    book = {}
+    if os.path.exists(BASELINE):
+        with open(BASELINE) as f:
+            book = json.load(f)
+    key = f"{kind}{'|quick' if args.quick else ''}"
+
+    if args.record:
+        book.setdefault(key, {}).update(results)
+        with open(BASELINE, "w") as f:
+            json.dump(book, f, indent=1, sort_keys=True)
+        print(f"baseline recorded for {key!r} -> {BASELINE}")
+        return 0
+
+    if args.check:
+        base = book.get(key, {})
+        bad = []
+        for name, ms in results.items():
+            ref = base.get(name)
+            if ref is None:
+                print(f"{name}: no baseline for {key!r} (skipped)")
+                continue
+            ratio = ms / ref
+            status = "OK" if ratio <= THRESHOLD else "REGRESSION"
+            print(f"{name:24s} {ms:10.3f} ms vs {ref:10.3f} ms "
+                  f"({ratio:5.2f}x) {status}")
+            if ratio > THRESHOLD:
+                bad.append((name, ratio))
+        if bad:
+            print(f"FAILED: {len(bad)} op(s) regressed >"
+                  f"{(THRESHOLD - 1) * 100:.0f}%: {bad}")
+            return 1
+        print("all ops within threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
