@@ -1,0 +1,315 @@
+"""Sharding completion + reshard prediction over a traced jaxpr.
+
+Reference analog: auto_parallel/completion.py:928 (the Completer —
+propagates ProcessMesh + dims_mapping annotations op by op over the
+serial ProgramDesc), partitioner.py and reshard.py (insert collectives
+where producer/consumer dist attrs disagree).
+
+TPU-native: XLA's GSPMD partitioner does the actual propagate/
+partition/reshard at compile time — what the framework still needs is
+the *reasoning* layer the reference builds these passes for: given
+parameter/input PartitionSpecs, walk the traced jaxpr with
+per-primitive SPMD rules, infer every intermediate's sharding, and
+record each point where GSPMD will have to insert a collective (the
+reshard set) with its byte volume and estimated time. That feeds the
+planner with per-candidate cost estimates that reflect the PROGRAM,
+not just parameter shapes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .cost_model import (CommContext, all_gather_cost, all_reduce_cost)
+
+__all__ = ["Reshard", "PropagationReport", "propagate_sharding"]
+
+Spec = Tuple[Optional[str], ...]  # one mesh-axis name (or None) per dim
+
+
+def _norm_spec(spec, ndim) -> Spec:
+    """PartitionSpec / tuple / None -> per-dim tuple padded to ndim."""
+    if spec is None:
+        return (None,) * ndim
+    entries = tuple(spec)
+    out = []
+    for e in entries[:ndim]:
+        if isinstance(e, (tuple, list)):  # multi-axis dim: keep first
+            out.append(e[0] if e else None)
+        else:
+            out.append(e)
+    out.extend([None] * (ndim - len(out)))
+    return tuple(out)
+
+
+@dataclass
+class Reshard:
+    """One predicted GSPMD collective insertion."""
+    prim: str
+    kind: str          # all_reduce / all_gather / replicate
+    axis: Optional[str]
+    nbytes: int
+    cost_us: float
+
+    def __repr__(self):
+        return (f"Reshard({self.prim}: {self.kind} over {self.axis}, "
+                f"{self.nbytes / 1e6:.2f} MB, {self.cost_us:.1f} us)")
+
+
+@dataclass
+class PropagationReport:
+    out_specs: List[Spec] = field(default_factory=list)
+    reshards: List[Reshard] = field(default_factory=list)
+    flops: float = 0.0
+
+    @property
+    def comm_us(self) -> float:
+        return sum(r.cost_us for r in self.reshards)
+
+    def comm_bytes(self, axis=None) -> int:
+        return sum(r.nbytes for r in self.reshards
+                   if axis is None or r.axis == axis)
+
+
+def _nbytes(aval) -> int:
+    return int(np.prod(aval.shape)) * aval.dtype.itemsize if aval.shape \
+        else aval.dtype.itemsize
+
+
+class _Propagator:
+    def __init__(self, mesh_dims: Dict[str, int], ctx: CommContext):
+        self.mesh = dict(mesh_dims)
+        self.ctx = ctx
+        self.report = PropagationReport()
+
+    # -- helpers ------------------------------------------------------------
+    def _axis_n(self, axis) -> int:
+        return int(self.mesh.get(axis, 1))
+
+    def _record(self, prim, kind, axis, nbytes):
+        n = self._axis_n(axis)
+        if n <= 1 or nbytes == 0:
+            return
+        if kind == "all_reduce":
+            cost = all_reduce_cost(nbytes, n, self.ctx, axis)
+        else:
+            cost = all_gather_cost(nbytes, n, self.ctx, axis)
+        self.report.reshards.append(
+            Reshard(prim, kind, axis, int(nbytes), float(cost)))
+
+    def _gather_to_replicated(self, prim, spec: Spec, aval) -> Spec:
+        """Record the all-gathers needed to fully replicate a value."""
+        for ax in spec:
+            if ax is not None:
+                self._record(prim, "all_gather",
+                             ax, _nbytes(aval) // self._axis_n(ax))
+        return (None,) * len(spec)
+
+    # -- per-primitive rules ------------------------------------------------
+    def _rule_elementwise(self, prim, in_specs, in_avals, out_avals):
+        """Same-shape operands: merge specs dim-wise; a conflict means
+        one operand reshards (gather the smaller)."""
+        out_ndim = len(out_avals[0].shape)
+        merged: List[Optional[str]] = [None] * out_ndim
+        for d in range(out_ndim):
+            axes = {s[d] for s, a in zip(in_specs, in_avals)
+                    if len(a.shape) == out_ndim and s[d] is not None}
+            if len(axes) == 1:
+                merged[d] = axes.pop()
+            elif len(axes) > 1:
+                # conflict: keep the majority/first, gather the others
+                keep = sorted(axes)[0]
+                merged[d] = keep
+                for s, a in zip(in_specs, in_avals):
+                    if s[d] is not None and s[d] != keep:
+                        self._record(prim, "all_gather", s[d],
+                                     _nbytes(a) // self._axis_n(s[d]))
+        return [tuple(merged)] * len(out_avals)
+
+    def _rule_dot_general(self, prim, params, in_specs, in_avals,
+                          out_avals):
+        ((lc, rc), (lb, rb)) = params["dimension_numbers"]
+        ls, rs = in_specs
+        la, ra = in_avals
+        out_ndim = len(out_avals[0].shape)
+        out: List[Optional[str]] = [None] * out_ndim
+        # contracting dims: matching shard -> partial result (psum);
+        # one-sided shard -> gather that operand
+        for dl, dr in zip(lc, rc):
+            al, ar = ls[dl], rs[dr]
+            if al is not None and al == ar:
+                self._record(prim, "all_reduce", al,
+                             _nbytes(out_avals[0]))
+            elif al is not None and ar is None:
+                self._record(prim, "all_gather", al,
+                             _nbytes(la) // self._axis_n(al))
+            elif ar is not None and al is None:
+                self._record(prim, "all_gather", ar,
+                             _nbytes(ra) // self._axis_n(ar))
+            elif al is not None and ar is not None:
+                self._record(prim, "all_gather", al,
+                             _nbytes(la) // self._axis_n(al))
+                self._record(prim, "all_gather", ar,
+                             _nbytes(ra) // self._axis_n(ar))
+        # output layout: batch dims, then left free, then right free
+        pos = 0
+        for dl, dr in zip(lb, rb):
+            out[pos] = ls[dl] if ls[dl] is not None else rs[dr]
+            pos += 1
+        for d in range(len(la.shape)):
+            if d not in lc and d not in lb:
+                out[pos] = ls[d]
+                pos += 1
+        for d in range(len(ra.shape)):
+            if d not in rc and d not in rb:
+                out[pos] = rs[d]
+                pos += 1
+        # model FLOPs: 2 * prod(out) * prod(contract)
+        contract = int(np.prod([la.shape[d] for d in lc])) if lc else 1
+        self.report.flops += 2.0 * float(np.prod(out_avals[0].shape)) \
+            * contract
+        return [tuple(out)]
+
+    def _rule_reduce(self, prim, params, in_specs, in_avals, out_avals):
+        axes = params.get("axes", ())
+        spec = in_specs[0]
+        for d in axes:
+            if spec[d] is not None:
+                # any reduction over a sharded dim needs a cross-shard
+                # combine of the output payload (sum -> psum, max ->
+                # all-reduce-max, ... — same wire cost)
+                self._record(prim, "all_reduce", spec[d],
+                             _nbytes(out_avals[0]))
+        out = tuple(s for d, s in enumerate(spec) if d not in axes)
+        return [out]
+
+    def _rule_transpose(self, prim, params, in_specs, in_avals, out_avals):
+        perm = params["permutation"]
+        return [tuple(in_specs[0][p] for p in perm)]
+
+    def _rule_reshape(self, prim, params, in_specs, in_avals, out_avals):
+        """Keep leading-dim shardings that survive the reshape (dim size
+        preserved in order); anything else reshards to replicated."""
+        spec, a, o = in_specs[0], in_avals[0], out_avals[0]
+        out: List[Optional[str]] = [None] * len(o.shape)
+        for d in range(min(len(a.shape), len(o.shape))):
+            if a.shape[d] != o.shape[d]:
+                break  # copy spec while leading dims match
+            out[d] = spec[d]
+        lost = [s for i, s in enumerate(spec) if s is not None
+                and (i >= len(out) or out[i] != s)]
+        for ax in lost:
+            self._record(prim, "all_gather", ax,
+                         _nbytes(a) // self._axis_n(ax))
+        return [tuple(out)]
+
+    # -- driver -------------------------------------------------------------
+    def run(self, jaxpr, in_specs: Sequence[Spec]):
+        env: Dict[Any, Spec] = {}
+
+        def read(v):
+            if hasattr(v, "val"):  # Literal
+                return (None,) * np.ndim(v.val)
+            return env.get(v, (None,) * len(v.aval.shape))
+
+        for var, spec in zip(jaxpr.invars, in_specs):
+            env[var] = _norm_spec(spec, len(var.aval.shape))
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            in_specs_e = [read(v) for v in eqn.invars]
+            in_avals = [v.aval if not hasattr(v, "val")
+                        else np.asarray(v.val) for v in eqn.invars]
+            out_avals = [v.aval for v in eqn.outvars]
+
+            if prim in ("pjit", "closed_call", "custom_jvp_call",
+                        "custom_vjp_call", "remat", "checkpoint",
+                        "custom_vjp_call_jaxpr"):
+                inner = eqn.params.get("jaxpr") or eqn.params.get(
+                    "call_jaxpr")
+                if inner is not None:
+                    inner_jaxpr = getattr(inner, "jaxpr", inner)
+                    sub_out = self.run_sub(inner_jaxpr, in_specs_e)
+                    for v, s in zip(eqn.outvars, sub_out):
+                        env[v] = s
+                    continue
+            rule_out = self._dispatch(prim, eqn.params, in_specs_e,
+                                      in_avals, out_avals)
+            for v, s in zip(eqn.outvars, rule_out):
+                env[v] = _norm_spec(s, len(v.aval.shape))
+        return [read(v) for v in jaxpr.outvars]
+
+    def run_sub(self, jaxpr, in_specs):
+        return self.run(jaxpr, in_specs)
+
+    def _dispatch(self, prim, params, in_specs, in_avals, out_avals):
+        if prim == "dot_general":
+            return self._rule_dot_general(prim, params, in_specs,
+                                          in_avals, out_avals)
+        if prim.startswith("reduce_"):
+            return self._rule_reduce(prim, params, in_specs, in_avals,
+                                     out_avals)
+        if prim == "transpose":
+            return self._rule_transpose(prim, params, in_specs, in_avals,
+                                        out_avals)
+        if prim == "reshape":
+            return self._rule_reshape(prim, params, in_specs, in_avals,
+                                      out_avals)
+        if prim in ("broadcast_in_dim", "convert_element_type", "copy",
+                    "stop_gradient", "integer_pow", "squeeze"):
+            spec = in_specs[0] if in_specs else ()
+            out = []
+            for o in out_avals:
+                out.append(_norm_spec(
+                    spec if len(o.shape) == len(in_avals[0].shape)
+                    else None, len(o.shape)))
+            return out
+        # same-shape (or scalar-broadcast) operands -> elementwise merge
+        if out_avals and all(
+                tuple(getattr(a, "shape", ())) in
+                (tuple(out_avals[0].shape), ())
+                for a in in_avals):
+            out_ndim = len(out_avals[0].shape)
+            full = [_norm_spec(s if np.ndim(a) == out_ndim else None,
+                               out_ndim)
+                    for s, a in zip(in_specs, in_avals)]
+            return self._rule_elementwise(prim, full, in_avals, out_avals)
+        # unknown shape-changing primitive: conservative replicate
+        out = []
+        for s, a in zip(in_specs, in_avals):
+            if any(x is not None for x in s):
+                self._gather_to_replicated(prim, s, a)
+        return [(None,) * len(o.shape) for o in out_avals]
+
+
+def propagate_sharding(fn, example_args, in_specs,
+                       mesh_dims: Dict[str, int],
+                       ctx: Optional[CommContext] = None
+                       ) -> PropagationReport:
+    """Trace ``fn`` and propagate input PartitionSpecs through it.
+
+    in_specs: pytree matching example_args with PartitionSpec / None
+    leaves. Returns a PropagationReport: inferred output specs, the
+    predicted reshard set (collective, axis, bytes, time) and model
+    FLOPs — the Completer+Resharder reasoning XLA performs implicitly,
+    surfaced for the planner.
+    """
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    flat_specs, _ = jax.tree_util.tree_flatten(
+        in_specs, is_leaf=lambda x: x is None or not isinstance(
+            x, (list, dict)))
+    flat_args = jax.tree_util.tree_leaves(example_args)
+    if len(flat_specs) != len(flat_args):
+        raise ValueError(
+            f"in_specs tree ({len(flat_specs)} leaves) does not match "
+            f"example_args ({len(flat_args)} leaves)")
+    prop = _Propagator(mesh_dims, ctx or CommContext())
+    norm = [_norm_spec(s, np.ndim(a))
+            for s, a in zip(flat_specs, flat_args)]
+    out = prop.run(closed.jaxpr, norm)
+    prop.report.out_specs = list(out)
+    return prop.report

@@ -356,10 +356,93 @@ class Engine:
             shard_params(self._model, self._mesh)
 
     def cost(self, inputs_spec=None, labels_spec=None, mode=None):
-        """reference: engine.py:1698 (cost model). Returns a coarse
-        (param_count, bytes) estimate; XLA's own cost model governs the
-        real schedule."""
+        """reference: engine.py:1698 (Engine.cost). Without specs:
+        coarse param count/bytes. With input specs: the completion-pass
+        estimate — the model's forward is traced, the current parameter
+        placements propagate through it (auto_parallel/completion.py),
+        and the result prices predicted collectives, model FLOPs and
+        per-device parameter memory for THIS mesh."""
         n = sum(int(np.prod(p.shape)) for p in self._model.parameters())
         by = sum(int(np.prod(p.shape)) * p._array.dtype.itemsize
                  for p in self._model.parameters())
-        return {"params": n, "bytes": by}
+        out = {"params": n, "bytes": by}
+        if inputs_spec is None:
+            return out
+
+        self.prepare()
+        from .planner import ProgramPlanner
+
+        def _example(spec):
+            if hasattr(spec, "shape"):  # InputSpec
+                shape, dt = spec.shape, getattr(spec, "dtype", "float32")
+            else:  # (shape, dtype) or bare shape
+                shape = spec[0] if isinstance(spec[0], (list, tuple)) \
+                    else spec
+                dt = spec[1] if (isinstance(spec[0], (list, tuple))
+                                 and len(spec) > 1) else "float32"
+            shape = [8 if d in (None, -1) else int(d) for d in shape]
+            return np.zeros(shape, np.dtype(getattr(dt, "name", dt)))
+
+        def as_list(s):
+            """One spec or a list of specs; a single spec may be an
+            InputSpec, a (shape, dtype) pair, or a bare shape list."""
+            if s is None:
+                return []
+            if hasattr(s, "shape"):
+                return [s]
+            if isinstance(s, (list, tuple)):
+                if (len(s) == 2 and isinstance(s[0], (list, tuple))
+                        and isinstance(s[1], str)):
+                    return [s]  # (shape, dtype)
+                if all(d is None or isinstance(d, int) for d in s):
+                    return [s]  # bare shape
+                return list(s)
+            return [s]
+
+        ins = [_example(s) for s in as_list(inputs_spec)]
+        labels = [_example(s) for s in as_list(labels_spec)] \
+            if labels_spec is not None else []
+        params = self._params
+        model, loss_fn = self._model, self._loss
+
+        def pure(param_arrays, *data):
+            saved = [p._array for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._set_array(a)
+                ins_t = [Tensor(a, stop_gradient=True)
+                         for a in data[:len(ins)]]
+                lab_t = [Tensor(a, stop_gradient=True)
+                         for a in data[len(ins):]]
+                model.eval()
+                with no_grad():
+                    outs = self._single(model(*ins_t))
+                    loss = loss_fn(outs, *lab_t) \
+                        if (loss_fn is not None and lab_t) else outs
+                    if isinstance(loss, (tuple, list)):
+                        loss = loss[0]
+                return loss._array
+            finally:
+                for p, a in zip(params, saved):
+                    p._set_array(a)
+
+        def spec_of(arr):
+            sh = getattr(arr, "sharding", None)
+            sp = getattr(sh, "spec", None)
+            return tuple(sp) if sp is not None else None
+
+        param_arrays = [p._array for p in params]
+        batch_specs = [("dp",) + (None,) * (a.ndim - 1) if a.ndim else ()
+                       for a in ins + labels]
+        mesh_dims = dict(self._mesh.shape)
+        planner = ProgramPlanner(mesh_dims)
+        score = planner.score(
+            pure, (param_arrays, *ins, *labels),
+            [[spec_of(a) for a in param_arrays], *batch_specs],
+            params={"p": param_arrays},
+            param_specs={"p": [spec_of(a) for a in param_arrays]})
+        # param memory: per-leaf shard factors (the dict-of-lists form
+        # above zips leaf-wise inside the planner)
+        out.update({k: v for k, v in score.items() if k != "report"})
+        out["reshards"] = [repr(r) for r in score["report"].reshards]
+        return out

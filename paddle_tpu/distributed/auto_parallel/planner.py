@@ -33,7 +33,7 @@ from jax.sharding import PartitionSpec as P
 from .cost_model import (CommContext, all_gather_cost, all_reduce_cost,
                          reduce_scatter_cost)
 
-__all__ = ["ShardingPlanner"]
+__all__ = ["ShardingPlanner", "ProgramPlanner", "plan_mesh"]
 
 
 class ShardingPlanner:
@@ -135,3 +135,110 @@ class ShardingPlanner:
             is_leaf=lambda x: hasattr(x, "shape") or (
                 isinstance(x, (tuple, list))
                 and all(isinstance(i, int) for i in x)))
+
+
+class ProgramPlanner:
+    """Whole-program candidate scoring over the completion pass.
+
+    Reference analog: planner_v2 + tuner — rank whole dist-attr
+    assignments by estimated step time, where the estimate comes from
+    propagating the candidate's shardings through the ACTUAL traced
+    program (completion.py) so contraction psums, activation gathers
+    and gradient syncs are all priced, not just parameter placement.
+    """
+
+    def __init__(self, mesh_dims: Dict[str, int],
+                 ctx: Optional[CommContext] = None,
+                 peak_flops: float = 459e12, dtype_bytes: int = 4,
+                 data_axes: Sequence[str] = ("dp",)):
+        self.mesh_dims = dict(mesh_dims)
+        self.ctx = ctx or CommContext()
+        self.peak = peak_flops
+        self.dtype_bytes = dtype_bytes
+        self.data_axes = list(data_axes)
+
+    def _param_mem_and_sync(self, params, specs):
+        import jax
+        flat_p = jax.tree_util.tree_leaves(params)
+        flat_s = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: x is None or isinstance(x, (tuple, P)))
+        mem = 0.0
+        sync_us = 0.0
+        for a, s in zip(flat_p, flat_s):
+            nb = int(np.prod(np.shape(a))) * self.dtype_bytes
+            entries = tuple(s) if s is not None else ()
+            factor = 1
+            for e in entries:
+                if e is not None:
+                    factor *= self.mesh_dims.get(e, 1)
+            mem += nb / factor
+            # gradient sync over every data axis the param is not
+            # sharded on (GSPMD psums grads across the batch axes)
+            for ax in self.data_axes:
+                n = self.mesh_dims.get(ax, 1)
+                if n > 1 and ax not in entries:
+                    sync_us += all_reduce_cost(nb / factor, n, self.ctx,
+                                               ax)
+        return mem, sync_us
+
+    def score(self, fn, example_args, in_specs, params=None,
+              param_specs=None):
+        """-> dict(total_us, comm_us, compute_us, grad_sync_us,
+        param_bytes_per_device, report)."""
+        from .completion import propagate_sharding
+
+        report = propagate_sharding(fn, example_args, in_specs,
+                                    self.mesh_dims, self.ctx)
+        # per-device compute: total model FLOPs spread over the mesh
+        # (assumes the matmuls shard over every axis — the estimate the
+        # reference cost model makes too; replicated compute shows up as
+        # an underestimate, acceptable for RANKING candidates)
+        n_dev = max(1, int(np.prod(list(self.mesh_dims.values() or [1]))))
+        compute_us = report.flops / (self.peak * n_dev) * 1e6
+        mem, sync_us = 0.0, 0.0
+        if params is not None and param_specs is not None:
+            mem, sync_us = self._param_mem_and_sync(params, param_specs)
+        return {
+            "total_us": report.comm_us + compute_us + sync_us,
+            "comm_us": report.comm_us,
+            "compute_us": compute_us,
+            "grad_sync_us": sync_us,
+            "param_bytes_per_device": mem,
+            "report": report,
+        }
+
+    def rank(self, candidates):
+        """candidates: list of (label, score_dict) -> sorted by
+        total_us ascending."""
+        return sorted(candidates, key=lambda c: c[1]["total_us"])
+
+
+def plan_mesh(fn, make_args_and_specs, n_devices: int,
+              axes: Sequence[str] = ("dp", "mp"),
+              ctx: Optional[CommContext] = None,
+              peak_flops: float = 459e12,
+              hbm_budget_bytes: Optional[float] = None):
+    """Search device-count factorizations over the named axes.
+
+    make_args_and_specs(mesh_dims) -> (example_args, in_specs, params,
+    param_specs) for that topology. Returns the ranked list of
+    (mesh_dims, score) with infeasible candidates (over HBM budget)
+    dropped — the tuner's search loop with the completion-pass cost
+    model as the objective.
+    """
+    cands = []
+    for a in range(1, n_devices + 1):
+        if n_devices % a:
+            continue  # every divisor pair, not just powers of two
+        b = n_devices // a
+        mesh_dims = {axes[0]: a, axes[1]: b}
+        args, in_specs, params, param_specs = make_args_and_specs(
+            mesh_dims)
+        planner = ProgramPlanner(mesh_dims, ctx, peak_flops,
+                                 data_axes=(axes[0],))
+        s = planner.score(fn, args, in_specs, params, param_specs)
+        if hbm_budget_bytes is not None and \
+                s["param_bytes_per_device"] > hbm_budget_bytes:
+            continue
+        cands.append((mesh_dims, s))
+    return sorted(cands, key=lambda c: c[1]["total_us"])

@@ -228,3 +228,25 @@ class TestRPC:
         assert msgs["async"] == [4]
         for names in msgs["infos"]:
             assert names == ["worker0", "worker1"]
+
+
+def test_engine_cost_with_specs():
+    """Engine.cost with input specs returns the completion-pass estimate
+    (reference: engine.py:1698) — FLOPs, predicted collectives, and
+    per-device parameter bytes for the current mesh."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    eng = Engine(net, loss=nn.CrossEntropyLoss())
+    coarse = eng.cost()
+    assert coarse["params"] == 16 * 32 + 32 + 32 * 4 + 4
+
+    full = eng.cost(inputs_spec=InputSpec([None, 16], "float32"),
+                    labels_spec=InputSpec([None], "int64"))
+    assert full["compute_us"] > 0
+    assert full["param_bytes_per_device"] > 0
+    assert full["total_us"] >= full["comm_us"]
+    assert isinstance(full["reshards"], list)

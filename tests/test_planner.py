@@ -89,3 +89,142 @@ def test_planner_hybrid_payload_not_overcharged():
     score = dict((tuple(c), s) for c, s in ranked)
     assert score[("dp", "mp")] < score[("dp", None)]
     assert set(tuple(best)) == {"dp", "mp"}
+
+
+# ---------------------------------------------------------------------------
+# round 4: completion pass (sharding propagation + reshard prediction)
+# and program-level planning (the Completer/Resharder/tuner reasoning)
+# ---------------------------------------------------------------------------
+
+def _mlp(x, w1, w2):
+    import jax.numpy as jnp
+    h = jnp.maximum(x @ w1, 0.0)
+    return jnp.sum(h @ w2)
+
+
+def test_completion_megatron_psum():
+    """Column-parallel then row-parallel matmul: the contraction where
+    BOTH operands shard on 'mp' must predict exactly one all_reduce
+    (Megatron's f/g collective), and the first matmul none."""
+    import numpy as np
+    from paddle_tpu.distributed.auto_parallel.completion import (
+        propagate_sharding)
+
+    x = np.zeros((8, 64), np.float32)
+    w1 = np.zeros((64, 128), np.float32)
+    w2 = np.zeros((128, 64), np.float32)
+    rep = propagate_sharding(
+        _mlp, (x, w1, w2),
+        [("dp", None), (None, "mp"), ("mp", None)],
+        mesh_dims={"dp": 2, "mp": 4})
+    ars = [r for r in rep.reshards if r.kind == "all_reduce"
+           and r.axis == "mp"]
+    assert len(ars) == 1, rep.reshards
+    # psum payload = the (batch, out) result of the second matmul
+    assert ars[0].nbytes == 8 * 64 * 4
+    # dp only appears for the scalar-loss reduce (no batch-dim psum of
+    # a non-reduced tensor)
+    gathers = [r for r in rep.reshards if r.kind == "all_gather"]
+    assert not gathers, rep.reshards
+
+
+def test_completion_detects_mismatched_contraction():
+    """x sharded on features vs replicated W -> the contraction gathers
+    the sharded operand."""
+    import numpy as np
+    from paddle_tpu.distributed.auto_parallel.completion import (
+        propagate_sharding)
+
+    x = np.zeros((8, 64), np.float32)
+    w = np.zeros((64, 32), np.float32)
+
+    def f(x, w):
+        return x @ w
+
+    rep = propagate_sharding(f, (x, w), [(None, "mp"), None],
+                             mesh_dims={"mp": 4})
+    gathers = [r for r in rep.reshards if r.kind == "all_gather"]
+    assert len(gathers) == 1
+    assert gathers[0].axis == "mp"
+    assert gathers[0].nbytes == 8 * 64 * 4 // 4  # x's shard
+
+
+def test_completion_flops_counted():
+    import numpy as np
+    from paddle_tpu.distributed.auto_parallel.completion import (
+        propagate_sharding)
+
+    x = np.zeros((8, 64), np.float32)
+    w1 = np.zeros((64, 128), np.float32)
+    w2 = np.zeros((128, 64), np.float32)
+    rep = propagate_sharding(_mlp, (x, w1, w2), [None, None, None],
+                             mesh_dims={})
+    want = 2 * 8 * 64 * 128 + 2 * 8 * 128 * 64
+    assert rep.flops == want
+
+
+def test_plan_mesh_regimes():
+    """The mesh search prefers tensor parallelism for giant weights with
+    a tiny batch, and data parallelism for small weights with a big
+    batch — the two textbook regimes."""
+    import numpy as np
+    from paddle_tpu.distributed.auto_parallel.planner import plan_mesh
+
+    def make_case(B, H):
+        def make(mesh_dims):
+            x = np.zeros((B, H), np.float32)
+            w1 = np.zeros((H, H), np.float32)
+            w2 = np.zeros((H, H), np.float32)
+            in_specs = [("dp", None), (None, "mp"), ("mp", None)]
+            params = {"w1": w1, "w2": w2}
+            param_specs = {"w1": (None, "mp"), "w2": ("mp", None)}
+            return (x, w1, w2), in_specs, params, param_specs
+        return make
+
+    # giant weights, tiny batch -> mp-heavy wins
+    ranked = plan_mesh(_mlp, make_case(8, 8192), 8)
+    best = ranked[0][0]
+    assert best["mp"] >= 4, ranked[:2]
+
+    # small weights, huge batch -> dp-heavy wins (activation psum would
+    # dominate under mp)
+    ranked = plan_mesh(_mlp, make_case(65536, 64), 8)
+    best = ranked[0][0]
+    assert best["dp"] >= 4, ranked[:2]
+
+
+def test_plan_mesh_non_power_of_two():
+    """Every divisor pair is enumerated (12 = 1x12..12x1), including the
+    pure-DP candidate."""
+    import numpy as np
+    from paddle_tpu.distributed.auto_parallel.planner import plan_mesh
+
+    def make(mesh_dims):
+        x = np.zeros((24, 64), np.float32)
+        w1 = np.zeros((64, 64), np.float32)
+        w2 = np.zeros((64, 64), np.float32)
+        return ((x, w1, w2),
+                [("dp", None), (None, "mp"), ("mp", None)],
+                {"w1": w1, "w2": w2},
+                {"w1": (None, "mp"), "w2": ("mp", None)})
+
+    ranked = plan_mesh(_mlp, make, 12)
+    meshes = {tuple(sorted(m.items())) for m, _ in ranked}
+    assert (("dp", 12), ("mp", 1)) in meshes
+    assert (("dp", 3), ("mp", 4)) in meshes
+    assert len(meshes) == 6
+
+
+def test_completion_reduce_max_costs():
+    """Non-sum reductions over a sharded dim also predict an all-reduce
+    (softmax's reduce_max case)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.auto_parallel.completion import (
+        propagate_sharding)
+
+    x = np.zeros((8, 64), np.float32)
+    rep = propagate_sharding(lambda x: jnp.max(x, axis=1), (x,),
+                             [(None, "mp")], mesh_dims={"mp": 4})
+    ars = [r for r in rep.reshards if r.kind == "all_reduce"]
+    assert len(ars) == 1 and ars[0].axis == "mp"
