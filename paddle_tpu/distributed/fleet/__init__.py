@@ -89,8 +89,17 @@ def distributed_model(model):
 def distributed_optimizer(optimizer, strategy=None):
     """reference: HybridParallelOptimizer
     (dygraph_optimizer/hybrid_parallel_optimizer.py:186). Grad clip is
-    already global under GSPMD (grads are full logical tensors in trace),
-    so the wrapper is the optimizer itself."""
+    already global under GSPMD (grads are full logical tensors in
+    trace), so the base wrapper is the optimizer itself; the
+    gradient_merge strategy (meta_optimizers/gradient_merge_optimizer)
+    wraps it in k-step accumulation."""
+    strategy = strategy or _FLEET_STATE.get("strategy")
+    if strategy is not None and getattr(strategy, "gradient_merge", False):
+        from .gradient_merge import GradientMergeOptimizer
+        cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+        return GradientMergeOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            avg=bool(cfg.get("avg", True)))
     return optimizer
 
 

@@ -1,0 +1,87 @@
+"""Gradient merge (k-step gradient accumulation) meta-optimizer.
+
+Reference analog: fleet/meta_optimizers/gradient_merge_optimizer.py and
+the dygraph accumulate_steps contract of pipeline_parallel — gradients
+from k micro-steps merge into one optimizer application, simulating a
+k-times-larger global batch without the memory.
+
+TPU-native: the eager tape already accumulates into p.grad across
+backward() calls, so the wrapper's job is the CADENCE — count steps,
+only let the inner optimizer (and LR schedule) advance every k-th call,
+and average the merged gradient when `avg` (the reference default).
+Works in eager loops and inside compiled steps (the counter is python
+state at trace time for the former, and hapi/engine drive it per real
+step).
+"""
+from __future__ import annotations
+
+__all__ = ["GradientMergeOptimizer"]
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._step_i = 0
+
+    # passthrough surface
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Eager: backward + merged step (the reference meta-optimizer's
+        apply cadence). Static programs apply the optimizer once per
+        Executor.run inside the compiled step, where k-step accumulation
+        must be expressed in the program itself — refuse loudly rather
+        than silently running unmerged."""
+        from ...static.program import recording_program
+        if recording_program() is not None:
+            raise NotImplementedError(
+                "gradient_merge with static-mode minimize(): drive the "
+                "merge cadence from the training loop instead (eager "
+                "backward()+step(), or scale accumulate_steps in the "
+                "pipeline/hapi config)")
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._inner._parameter_list]
+
+    def step(self):
+        self._step_i += 1
+        if self._step_i % self.k_steps:
+            return  # keep accumulating; do NOT clear grads between
+        if self.avg and self.k_steps > 1:
+            for p in self._inner._parameter_list:
+                if p.grad is not None:
+                    p.grad = p.grad * (1.0 / self.k_steps)
+        self._inner.step()
+        self._inner.clear_grad()
+
+    def clear_grad(self, set_to_zero=True):
+        # between merged applications the accumulated grads must
+        # survive the user's step()/clear_grad() loop idiom; only a
+        # boundary (just-applied) clear is real
+        if self._step_i % self.k_steps == 0:
+            self._inner.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        # the accumulated grads (p.grad) are NOT part of optimizer
+        # state: a checkpoint taken mid-accumulation resumes at the last
+        # BOUNDARY — persisting the raw counter would make the first
+        # post-restore boundary average k grads while only having
+        # accumulated the post-restore ones
+        sd = self._inner.state_dict()
+        sd["__gm_step__"] = self._step_i - (self._step_i % self.k_steps)
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._step_i = int(sd.pop("__gm_step__", 0))
+        self._inner.set_state_dict(sd)

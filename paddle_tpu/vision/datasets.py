@@ -162,9 +162,11 @@ def _default_loader(path):
 
 
 def _walk_files(root, exts, is_valid_file):
-    """Sorted recursive walk yielding files passing the filter (shared
-    by DatasetFolder/ImageFolder; hidden dirs are skipped)."""
-    for base, dirs, files in sorted(os.walk(root)):
+    """Deterministic recursive walk yielding files passing the filter
+    (shared by DatasetFolder/ImageFolder). The walk must stay LAZY so
+    the dirs[:] mutation actually prunes hidden directories —
+    sorted(os.walk(...)) would exhaust the generator before pruning."""
+    for base, dirs, files in os.walk(root):
         dirs[:] = sorted(d for d in dirs if not d.startswith("."))
         for fname in sorted(files):
             path = os.path.join(base, fname)

@@ -139,3 +139,17 @@ def test_flowers_and_voc_train():
         if i >= 2:
             break
     assert np.isfinite(float(loss.numpy()))
+
+
+def test_dataset_folder_skips_hidden_dirs(tmp_path):
+    import numpy as np
+    from paddle_tpu.vision.datasets import DatasetFolder
+
+    d = tmp_path / "cat"
+    d.mkdir()
+    np.save(d / "a.npy", np.zeros((1, 4, 4), np.float32))
+    h = d / ".ipynb_checkpoints"
+    h.mkdir()
+    np.save(h / "junk.npy", np.zeros((1, 4, 4), np.float32))
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 1  # the hidden dir's file is pruned
