@@ -12,9 +12,10 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply_op
 from ..ops.registry import register, _ensure_tensor
 
-__all__ = ["nms", "nms_padded", "box_iou", "roi_align", "deform_conv2d",
-           "box_coder", "prior_box", "yolo_box", "roi_pool", "psroi_pool",
-           "matrix_nms", "distribute_fpn_proposals", "generate_proposals",
+__all__ = ["nms", "nms_padded", "multiclass_nms", "box_iou", "roi_align",
+           "deform_conv2d", "box_coder", "prior_box", "yolo_box",
+           "roi_pool", "psroi_pool", "matrix_nms",
+           "distribute_fpn_proposals", "generate_proposals",
            "DeformConv2D"]
 
 
@@ -44,25 +45,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         s = np.ones(len(b), np.float32)
     else:
         s = np.asarray(_ensure_tensor(scores)._array)
-    order = np.argsort(-s)
-    keep = []
-    suppressed = np.zeros(len(b), bool)
-    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    for i in order:
-        if suppressed[i]:
-            continue
-        keep.append(i)
-        xx1 = np.maximum(b[i, 0], b[:, 0])
-        yy1 = np.maximum(b[i, 1], b[:, 1])
-        xx2 = np.minimum(b[i, 2], b[:, 2])
-        yy2 = np.minimum(b[i, 3], b[:, 3])
-        w = np.clip(xx2 - xx1, 0, None)
-        h = np.clip(yy2 - yy1, 0, None)
-        inter = w * h
-        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
-        suppressed |= iou > iou_threshold
-        suppressed[i] = False
-    keep = np.asarray(keep, np.int64)
+    keep = _greedy_nms_np(b, s, iou_threshold)
     if top_k is not None:
         keep = keep[:top_k]
     return Tensor(jnp.asarray(keep))
@@ -492,6 +475,98 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     outs[r, :, i, j] = fmap[grp:grp + out_c,
                                             ys:ye, xs:xe].mean((1, 2))
     return Tensor(jnp.asarray(outs))
+
+
+def _greedy_nms_np(b, s, thr, normalized=True, eta=1.0):
+    """Greedy suppression core shared by nms/multiclass_nms.
+    normalized=False adds the reference's +1 pixel offset to areas/
+    intersections; eta < 1 adaptively tightens the threshold after each
+    kept box (the SSD nms_eta contract)."""
+    norm = 0.0 if normalized else 1.0
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0] + norm) * (b[:, 3] - b[:, 1] + norm)
+    adaptive = thr
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.clip(xx2 - xx1 + norm, 0, None) * \
+            np.clip(yy2 - yy1 + norm, 0, None)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > adaptive
+        suppressed[i] = False
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(keep, np.int64)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                   keep_top_k=-1, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """Per-class greedy NMS + cross-class top-k (reference:
+    operators/detection/multiclass_nms_op / multiclass_nms3). Host-side
+    like the reference CPU kernel (dynamic output count).
+
+    Batched form: bboxes [N, M, 4], scores [N, C, M]. Dynamic-ROIs form
+    (rois_num given): bboxes [M, 4], scores [M, C] with rois_num [N]
+    splitting the M rows per image. background_label defaults to 0 like
+    the reference (pass -1 to keep every class). Returns (out [K, 6]
+    rows of [label, score, x1, y1, x2, y2], optional flat index,
+    rois_num [N]).
+    """
+    _host_only("multiclass_nms", bboxes, scores)
+    bb = np.asarray(_ensure_tensor(bboxes)._array, np.float32)
+    sc = np.asarray(_ensure_tensor(scores)._array, np.float32)
+    if rois_num is not None:
+        rn = np.asarray(_ensure_tensor(rois_num)._array).reshape(-1)
+        if bb.ndim != 2 or sc.ndim != 2:
+            raise ValueError(
+                "multiclass_nms with rois_num expects bboxes [M, 4] and "
+                f"scores [M, C]; got {bb.shape} / {sc.shape}")
+        starts = np.concatenate([[0], np.cumsum(rn)]).astype(int)
+        groups = [(bb[starts[i]:starts[i + 1]],
+                   sc[starts[i]:starts[i + 1]].T,  # -> [C, m]
+                   starts[i]) for i in range(len(rn))]
+    else:
+        groups = [(bb[n], sc[n], n * bb.shape[1])
+                  for n in range(bb.shape[0])]
+    outs, idxs, counts = [], [], []
+    for boxes_n, scores_n, base in groups:
+        C = scores_n.shape[0]
+        dets = []  # (label, score, box, flat_index)
+        for c in range(C):
+            if c == background_label:
+                continue
+            cand = np.nonzero(scores_n[c] > score_threshold)[0]
+            if cand.size == 0:
+                continue
+            if nms_top_k > 0 and cand.size > nms_top_k:
+                cand = cand[np.argsort(-scores_n[c, cand])[:nms_top_k]]
+            keep = _greedy_nms_np(boxes_n[cand], scores_n[c, cand],
+                                  nms_threshold, normalized=normalized,
+                                  eta=nms_eta)
+            for j in cand[keep]:
+                dets.append((c, scores_n[c, j], boxes_n[j], base + j))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for c, s, box, fi in dets:
+            outs.append([float(c), float(s), *box.tolist()])
+            idxs.append(fi)
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    nums = Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+    index = Tensor(jnp.asarray(np.asarray(idxs, np.int64).reshape(-1, 1)))
+    if return_index:
+        return (out, index, nums) if return_rois_num else (out, index)
+    return (out, nums) if return_rois_num else out
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,

@@ -363,3 +363,55 @@ def test_sample_neighbors_raises_under_jit():
     nodes = np.array([0, 1], np.int64)
     with pytest.raises(TypeError, match="host"):
         jax.jit(lambda r: geometric.sample_neighbors(r, colptr, nodes))(row)
+
+
+def test_multiclass_nms():
+    """Per-class NMS + cross-class keep_top_k (reference:
+    multiclass_nms3 op)."""
+    from paddle_tpu.vision.ops import multiclass_nms
+
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                       [0, 0, 2, 2]]], np.float32)          # [1, 4, 4]
+    scores = np.array([[[0.9, 0.85, 0.2, 0.05],              # class 0
+                        [0.1, 0.2, 0.95, 0.02]]], np.float32)  # [1, 2, 4]
+
+    out, index, nums = multiclass_nms(
+        boxes, scores, score_threshold=0.1, nms_threshold=0.5,
+        keep_top_k=10, background_label=-1, return_index=True)
+    o = out.numpy()
+    assert int(nums.numpy()[0]) == len(o) == 4
+    # class 0: box0 (0.9) suppresses its twin box1, box2 (0.2) survives;
+    # class 1: box2 (0.95) and box1 (0.2) don't overlap — both kept
+    labels = o[:, 0].astype(int).tolist()
+    assert labels.count(0) == 2 and labels.count(1) == 2
+    # sorted by score across classes: 0.95 (c1) first
+    assert o[0, 0] == 1 and 0.94 < o[0, 1] < 0.96
+    assert (np.diff(o[:, 1]) <= 1e-6).all()
+    # index points back at the flat box slots
+    assert index.numpy().shape == (4, 1)
+    # keep_top_k trims across classes to the single best detection
+    out2, nums2 = multiclass_nms(boxes, scores, score_threshold=0.1,
+                                 nms_threshold=0.5, keep_top_k=1,
+                                 background_label=-1)
+    assert int(nums2.numpy()[0]) == 1
+    assert out2.numpy()[0, 0] == 1  # the 0.95 class-1 det
+    # background_label drops its class entirely
+    out3, nums3 = multiclass_nms(boxes, scores, score_threshold=0.1,
+                                 nms_threshold=0.5, background_label=1)
+    assert (out3.numpy()[:, 0] == 0).all()
+
+    # dynamic-ROIs form: same detections via rois_num splitting
+    out4, nums4 = multiclass_nms(
+        boxes[0], scores[0].T, score_threshold=0.1, nms_threshold=0.5,
+        background_label=-1, rois_num=np.array([4], np.int32))
+    np.testing.assert_allclose(out4.numpy(), o, rtol=1e-6)
+    # nms_eta < 1 tightens the threshold after each kept box
+    near = np.array([[[0, 0, 10, 10], [0, 4, 10, 14],
+                      [0, 8, 10, 18]]], np.float32)
+    nsc = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    _, n_fixed = multiclass_nms(near, nsc, score_threshold=0.1,
+                                nms_threshold=0.6, background_label=-1)
+    _, n_eta = multiclass_nms(near, nsc, score_threshold=0.1,
+                              nms_threshold=0.6, nms_eta=0.1,
+                              background_label=-1)
+    assert int(n_eta.numpy()[0]) < int(n_fixed.numpy()[0])
