@@ -10,7 +10,8 @@ from ...nn.functional.common import scaled_dot_product_attention
 
 __all__ = ["fused_matmul_bias", "fused_linear", "fused_feedforward",
            "fused_multi_head_attention", "fused_dropout_add",
-           "fused_rotary_position_embedding", "swiglu"]
+           "fused_rotary_position_embedding", "swiglu",
+           "sparse_attention"]
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
@@ -163,3 +164,61 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias,
                            ln2_epsilon)
     return out
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block/CSR-masked attention (reference:
+    python/paddle/incubate/nn/functional/sparse_attention.py over the
+    sparse_attention CUDA kernel). Per (batch, head) a CSR pattern —
+    offset [B, H, M+1], columns [B, H, nnz] — names the key positions
+    each query row may attend to; everything else is -inf before the
+    softmax.
+
+    TPU-native: the pattern lowers to a boolean mask built with one
+    scatter (rows recovered from the CSR offsets by searchsorted), and
+    the masked softmax-attention runs as dense MXU matmuls — on TPU the
+    win of the CUDA gather kernel belongs to Pallas flash variants; this
+    op's contract is the SEMANTICS of CSR-restricted attention,
+    differentiable through q/k/v.
+    """
+    q = _ensure_tensor(query)
+    k = _ensure_tensor(key)
+    v = _ensure_tensor(value)
+    off = _ensure_tensor(sparse_csr_offset)
+    cols = _ensure_tensor(sparse_csr_columns)
+    # masks are not differentiated: close over their arrays (reference:
+    # a 0 in either mask maps to -inf pre-softmax)
+    kpm = None if key_padding_mask is None else \
+        _ensure_tensor(key_padding_mask)._array
+    am = None if attn_mask is None else _ensure_tensor(attn_mask)._array
+
+    def _f(qa, ka, va, offa, colsa):
+        B, H, M, D = qa.shape
+        nnz = colsa.shape[-1]
+        scores = jnp.einsum("bhmd,bhnd->bhmn", qa, ka) / jnp.sqrt(
+            jnp.asarray(D, qa.dtype))
+        flat_off = offa.reshape(B * H, M + 1)
+        t = jnp.arange(nnz)
+        rows = jax.vmap(
+            lambda o: jnp.searchsorted(o, t, side="right") - 1)(flat_off)
+        rows = rows.reshape(B, H, nnz)
+        bi = jnp.arange(B)[:, None, None]
+        hi = jnp.arange(H)[None, :, None]
+        mask = jnp.zeros((B, H, M, M), bool).at[
+            bi, hi, rows, colsa].set(True)
+        neg = jnp.asarray(jnp.finfo(qa.dtype).min, qa.dtype)
+        scores = jnp.where(mask, scores, neg)
+        if kpm is not None:  # [B, M] over keys
+            scores = jnp.where(kpm[:, None, None, :] == 0, neg, scores)
+        if am is not None:   # [M, M]
+            scores = jnp.where(am[None, None] == 0, neg, scores)
+        attn = jax.nn.softmax(scores, axis=-1)
+        # rows with an empty CSR slice must output zeros, not a uniform
+        # average of garbage
+        any_allowed = mask.any(-1, keepdims=True)
+        attn = jnp.where(any_allowed, attn, 0.0)
+        return jnp.einsum("bhmn,bhnd->bhmd", attn, va)
+
+    return apply_op(_f, q, k, v, off, cols, op_name="sparse_attention")

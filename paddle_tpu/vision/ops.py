@@ -547,7 +547,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
             cand = np.nonzero(scores_n[c] > score_threshold)[0]
             if cand.size == 0:
                 continue
-            if nms_top_k > 0 and cand.size > nms_top_k:
+            if nms_top_k > -1 and cand.size > nms_top_k:
                 cand = cand[np.argsort(-scores_n[c, cand])[:nms_top_k]]
             keep = _greedy_nms_np(boxes_n[cand], scores_n[c, cand],
                                   nms_threshold, normalized=normalized,
@@ -555,7 +555,7 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
             for j in cand[keep]:
                 dets.append((c, scores_n[c, j], boxes_n[j], base + j))
         dets.sort(key=lambda d: -d[1])
-        if keep_top_k > 0:
+        if keep_top_k > -1:  # reference: 0 keeps nothing, -1 unlimited
             dets = dets[:keep_top_k]
         counts.append(len(dets))
         for c, s, box, fi in dets:
@@ -622,7 +622,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             for k in np.nonzero(keep)[0]:
                 dets.append((c, ds[k], b[k], idxs[k]))
         dets.sort(key=lambda d: -d[1])
-        if keep_top_k > 0:
+        if keep_top_k > -1:  # reference: 0 keeps nothing, -1 unlimited
             dets = dets[:keep_top_k]
         out = np.asarray([[d[0], d[1], *d[2]] for d in dets],
                          np.float32).reshape(-1, 6)

@@ -176,3 +176,95 @@ def test_linalg_namespace_complete():
     for name in ["cholesky", "svd", "qr", "lu", "lu_unpack", "pinv",
                  "lstsq", "matrix_power", "householder_product"]:
         assert hasattr(paddle.linalg, name), name
+
+
+def test_sparse_attention_matches_masked_dense():
+    """CSR-restricted attention == dense attention with -inf outside the
+    pattern (reference: incubate sparse_attention kernel tests)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import sparse_attention
+
+    rng = np.random.default_rng(0)
+    B, H, M, D = 2, 2, 6, 4
+    q, k, v = (rng.standard_normal((B, H, M, D)).astype(np.float32)
+               for _ in range(3))
+
+    # random CSR pattern: each row keeps a random nonempty subset
+    offs = np.zeros((B, H, M + 1), np.int32)
+    cols_l = [[[] for _ in range(H)] for _ in range(B)]
+    for b in range(B):
+        for h in range(H):
+            for m in range(M):
+                keep = sorted(rng.choice(M, rng.integers(1, M + 1),
+                                         replace=False).tolist())
+                cols_l[b][h].extend(keep)
+                offs[b, h, m + 1] = len(cols_l[b][h])
+    nnz = max(len(cols_l[b][h]) for b in range(B) for h in range(H))
+    # pad ragged rows per (b,h): replicate last col entry with an extra
+    # offset bump-free tail (tail entries belong to the LAST row slice
+    # boundary, so pad by extending the final row's columns)
+    cols = np.zeros((B, H, nnz), np.int32)
+    for b in range(B):
+        for h in range(H):
+            cl = cols_l[b][h]
+            while len(cl) < nnz:  # pad final row with duplicate col
+                cl = cl + [cl[-1]]
+                offs[b, h, M] = len(cl)
+            cols[b, h] = cl
+
+    out = sparse_attention(q, k, v, offs, cols).numpy()
+
+    # dense reference
+    scores = np.einsum("bhmd,bhnd->bhmn", q, k) / np.sqrt(D)
+    mask = np.zeros((B, H, M, M), bool)
+    for b in range(B):
+        for h in range(H):
+            for m in range(M):
+                for t in range(offs[b, h, m], offs[b, h, m + 1]):
+                    mask[b, h, m, cols[b, h, t]] = True
+    scores = np.where(mask, scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    attn = e / e.sum(-1, keepdims=True)
+    want = np.einsum("bhmn,bhnd->bhmd", attn, v)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    # differentiable through q
+    g = jax.grad(lambda qa: float(0) + sparse_attention(
+        paddle.to_tensor(qa), k, v, offs, cols)._array.sum())(
+        paddle.to_tensor(q)._array)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_sparse_attention_masks_and_topk_zero():
+    import numpy as np
+    from paddle_tpu.incubate.nn.functional import sparse_attention
+    from paddle_tpu.vision.ops import multiclass_nms
+
+    rng = np.random.default_rng(1)
+    B, H, M, D = 1, 1, 4, 2
+    q, k, v = (rng.standard_normal((B, H, M, D)).astype(np.float32)
+               for _ in range(3))
+    # full pattern
+    offs = np.tile(np.arange(M + 1, dtype=np.int32) * M, (B, H, 1))
+    cols = np.tile(np.arange(M, dtype=np.int32), (B, H, M)).reshape(
+        B, H, M * M)
+    kpm = np.array([[1, 1, 1, 0]], np.float32)  # pad out last key
+    out = sparse_attention(q, k, v, offs, cols,
+                           key_padding_mask=kpm).numpy()
+    # the padded key contributes nothing: recompute without key 3
+    scores = np.einsum("bhmd,bhnd->bhmn", q, k) / np.sqrt(D)
+    scores[..., 3] = -1e30
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    want = np.einsum("bhmn,bhnd->bhmd",
+                     e / e.sum(-1, keepdims=True), v)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    # reference parity: keep_top_k=0 keeps NOTHING (not everything)
+    boxes = np.zeros((1, 2, 4), np.float32)
+    boxes[0, :, 2:] = 10
+    scores2 = np.full((1, 2, 2), 0.9, np.float32)
+    out2, nums2 = multiclass_nms(boxes, scores2, score_threshold=0.1,
+                                 keep_top_k=0, background_label=-1)
+    assert int(nums2.numpy()[0]) == 0
