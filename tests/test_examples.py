@@ -29,7 +29,8 @@ def _run(name, timeout=420):
 
 @pytest.mark.parametrize("script", ["train_lenet.py",
                                     "pretrain_llama_mesh.py",
-                                    "generate_text.py"])
+                                    "generate_text.py",
+                                    "recommender_host_embedding.py"])
 def test_example_runs(script):
     proc = _run(script)
     assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
