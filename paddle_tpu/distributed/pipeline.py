@@ -32,9 +32,16 @@ __all__ = ["pipeline_forward", "pipeline_loss_fn",
            "pipeline_interleaved_forward", "pipeline_interleaved_loss_fn"]
 
 
-def pipeline_forward(cfg, mesh, n_micro, params, ids):
+def pipeline_forward(cfg, mesh, n_micro, params, ids, cp_axis=None):
     """ids -> (hidden_states [B,S,H], aux) with the decoder stack pipelined
-    over 'pp'. Embedding and head stay in the GSPMD (auto) region."""
+    over 'pp'. Embedding and head stay in the GSPMD (auto) region.
+
+    cp_axis: also shard the SEQUENCE over this mesh axis inside the
+    pipeline region and run axis-level ring attention per stage —
+    context parallelism composed with pipeline parallelism (the
+    long-context regime the reference never shipped: each stage holds
+    S/n_sp of every microbatch's activations and rotates K/V blocks
+    around the sp ring while activations hop the pp ring)."""
     from ..models.llama import _rope_tables, run_layer_stack
 
     B, S = ids.shape
@@ -53,7 +60,9 @@ def pipeline_forward(cfg, mesh, n_micro, params, ids):
             state, outputs, aux = carry
             idx0 = jnp.clip(t, 0, n_micro - 1)
             inp = jnp.where(stage == 0, x_stack[idx0], state)
-            out, a = run_layer_stack(cfg, layers_local, inp, sin_, cos_)
+            out, a = run_layer_stack(cfg, layers_local, inp, sin_, cos_,
+                                     cp_axis=cp_axis,
+                                     cp_axis_level=cp_axis is not None)
             out_idx = t - (n_stages - 1)
             valid_out = (stage == n_stages - 1) & (out_idx >= 0)
             upd = lax.dynamic_update_index_in_dim(
@@ -71,19 +80,33 @@ def pipeline_forward(cfg, mesh, n_micro, params, ids):
         (state, outputs, aux), _ = lax.scan(
             step, carry0, jnp.arange(n_micro + n_stages - 1))
         # replicate the last stage's result across pp (loss/head computed
-        # in the auto region); scalar aux sums contributions of all stages
+        # in the auto region). aux: stages hold disjoint layer slices
+        # (sum over pp), microbatches each contribute a full-batch-mean
+        # quantity (divide by n_micro to match loss_fn/1F1B), and cp
+        # shards each hold a token-normalized mean (pmean over sp, not
+        # psum — a sum would scale the load-balance loss by n_sp)
         outputs = lax.psum(
             jnp.where(stage == n_stages - 1, outputs,
                       jnp.zeros_like(outputs)), "pp")
-        aux = lax.psum(aux, "pp")
+        aux = lax.psum(aux, "pp") / n_micro
+        if cp_axis is not None:
+            aux = lax.pmean(aux, cp_axis)
         return outputs, aux
 
     layer_manual_specs = jax.tree_util.tree_map(lambda a: P("pp"), layers)
+    if cp_axis is None:
+        x_spec, rope_spec, axes = P(), P(), {"pp"}
+    else:
+        # sequence dim sharded over the cp axis; rope tables slice along
+        # S so each shard sees its own absolute positions
+        x_spec = P(None, None, cp_axis, None)
+        rope_spec = P(cp_axis, None)
+        axes = {"pp", cp_axis}
     outputs, aux = jax.shard_map(
         stage_body, mesh=mesh,
-        in_specs=(layer_manual_specs, P(), P(), P()),
-        out_specs=(P(), P()),
-        axis_names={"pp"}, check_vma=False)(layers, x_mb, sin, cos)
+        in_specs=(layer_manual_specs, x_spec, rope_spec, rope_spec),
+        out_specs=(x_spec, P()),
+        axis_names=axes, check_vma=False)(layers, x_mb, sin, cos)
     h = outputs.reshape(B, S, x.shape[-1])
     return h, aux
 
@@ -100,10 +123,10 @@ def _head_loss(cfg, params, h, labels, aux):
     return ce + 0.01 * aux, ce
 
 
-def pipeline_loss_fn(cfg, mesh, n_micro, params, batch):
+def pipeline_loss_fn(cfg, mesh, n_micro, params, batch, cp_axis=None):
     """Full pipelined loss (used by models.llama.build_train_step)."""
     h, aux = pipeline_forward(cfg, mesh, n_micro, params,
-                              batch["input_ids"])
+                              batch["input_ids"], cp_axis=cp_axis)
     return _head_loss(cfg, params, h, batch["labels"], aux)
 
 

@@ -185,7 +185,7 @@ def _rms_norm(x, w, eps):
 
 
 def _attention(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
-               cp_axis="sp"):
+               cp_axis="sp", cp_axis_level=False):
     B, S, H = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, \
         cfg.head_dim
@@ -194,7 +194,13 @@ def _attention(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
     v = (x @ lp["wv"]).reshape(B, S, nkv, d)
     q = _apply_rope(q, sin, cos)
     k = _apply_rope(k, sin, cos)
-    if cp_mesh is not None:
+    if cp_axis_level:
+        # already inside a shard_map that maps cp_axis (the pipeline's
+        # pp x sp region): call the axis-level ring directly — nesting
+        # another shard_map here would be illegal
+        from ..distributed.sequence_parallel import ring_attention
+        out = ring_attention(q, k, v, axis_name=cp_axis)
+    elif cp_mesh is not None:
         # context parallel: sequence sharded over cp_axis, K/V blocks
         # rotate the ring (distributed.sequence_parallel) — exact causal
         # attention at O(S/n) memory per device. GQA expansion happens
@@ -262,10 +268,11 @@ def _moe_mlp(cfg: LlamaConfig, lp, x):
 
 
 def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
-                  cp_axis="sp"):
+                  cp_axis="sp", cp_axis_level=False):
     """One decoder block on a per-layer param slice (no leading L axis)."""
     h = x + _attention(cfg, lp, _rms_norm(x, lp["ln1"], cfg.rms_norm_eps),
-                       sin, cos, cp_mesh=cp_mesh, cp_axis=cp_axis)
+                       sin, cos, cp_mesh=cp_mesh, cp_axis=cp_axis,
+                       cp_axis_level=cp_axis_level)
     normed = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
     if cfg.moe_num_experts > 0:
         mlp_out, aux = _moe_mlp(cfg, lp, normed)
@@ -274,10 +281,11 @@ def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
 
 
 def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos,
-                    cp_mesh=None, cp_axis="sp"):
+                    cp_mesh=None, cp_axis="sp", cp_axis_level=False):
     """lax.scan over the stacked layer axis (compiler-friendly sequential
     control flow; remat per layer = the recompute strategy)."""
-    layer_fn = functools.partial(decoder_layer, cp_mesh=cp_mesh,
+    layer_fn = functools.partial(decoder_layer, cp_axis_level=cp_axis_level,
+                                 cp_mesh=cp_mesh,
                                  cp_axis=cp_axis)
 
     def body(carry, lp):
@@ -435,10 +443,13 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     mesh = topo.mesh
     pp = topo.pp_degree
     use_pp = (pp > 1) if use_pp is None else use_pp
-    if use_pp and getattr(topo, "sp_degree", 1) > 1:
+    cp_in_pp = use_pp and getattr(topo, "sp_degree", 1) > 1
+    if cp_in_pp and schedule != "gpipe":
         raise ValueError(
-            "context parallelism (sp > 1) is not supported together "
-            "with pipeline parallelism yet; use sp with dp/mp only")
+            "context parallelism (sp > 1) composes with pipeline "
+            "parallelism on the GPipe schedule only (ring attention "
+            "inside the pp x sp shard_map); use schedule='gpipe' or "
+            "drop one axis")
     opt = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     specs = param_specs(cfg)
 
@@ -461,7 +472,8 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     elif use_pp:
         from ..distributed.pipeline import pipeline_loss_fn
         loss = functools.partial(pipeline_loss_fn, cfg, mesh,
-                                 n_microbatches or pp)
+                                 n_microbatches or pp,
+                                 cp_axis="sp" if cp_in_pp else None)
     else:
         cp_mesh = mesh if getattr(topo, "sp_degree", 1) > 1 else None
 

@@ -136,7 +136,9 @@ def test_ring_attention_gqa_expands_at_use():
                                rtol=2e-4, atol=2e-4)
 
 
-def test_cp_with_pp_raises():
+def test_cp_with_pp_gpipe_builds():
+    """sp + pp now composes on the GPipe schedule (the default); the
+    old blanket restriction is retired."""
     from paddle_tpu.distributed.mesh import HybridTopology
     from paddle_tpu.models.llama import LlamaConfig, build_train_step
     cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=32,
@@ -145,5 +147,65 @@ def test_cp_with_pp_raises():
                       dtype=jnp.float32, use_remat=False)
     topo = HybridTopology(dp=1, pp=2, sharding=1, mp=1, sp=2,
                           devices=jax.devices()[:4])
-    with pytest.raises(ValueError, match="context parallelism"):
-        build_train_step(cfg, topo)
+    step_fn, init_fn = build_train_step(cfg, topo)  # must not raise
+    assert callable(step_fn)
+
+
+def test_ring_attention_composes_with_pipeline():
+    """CP x PP: ring attention (sp) inside the GPipe pipeline region
+    (pp), with dp on the batch — the long-context regime the round-3
+    review flagged as unsupported. Loss must match the unsharded
+    computation and a training step must produce finite, updated
+    params."""
+    import numpy as np
+    from paddle_tpu.distributed.mesh import HybridTopology
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        dtype=jnp.float32, use_remat=False)
+    topo = HybridTopology(dp=2, pp=2, sp=2,
+                          devices=jax.devices("cpu")[:8])
+    step_fn, init_fn = llama.build_train_step(cfg, topo, use_pp=True,
+                                              n_microbatches=2,
+                                              schedule="gpipe")
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, 128, (4, 32)), jnp.int32),
+    }
+
+    # parity of the pipelined+CP loss against the plain computation
+    from paddle_tpu.distributed.pipeline import pipeline_loss_fn
+    with topo.mesh:
+        total, ce = jax.jit(
+            lambda p, b: pipeline_loss_fn(cfg, topo.mesh, 2, p, b,
+                                          cp_axis="sp"))(params, batch)
+    plain_total, plain_ce = llama.loss_fn(cfg, params, batch)
+    np.testing.assert_allclose(float(ce), float(plain_ce), rtol=2e-4,
+                               atol=2e-4)
+
+    before = [np.asarray(a) for a in jax.tree_util.tree_leaves(params)]
+    params2, opt_state, metrics = step_fn(params, opt_state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    # params actually moved (snapshot taken before donation freed them)
+    delta = sum(float(np.abs(np.asarray(a) - b).sum()) for a, b in zip(
+        jax.tree_util.tree_leaves(params2), before))
+    assert delta > 0
+
+
+def test_cp_with_1f1b_raises_clearly():
+    from paddle_tpu.distributed.mesh import HybridTopology
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2,
+        num_key_value_heads=2, max_position_embeddings=32,
+        dtype=jnp.float32, use_remat=False)
+    topo = HybridTopology(pp=2, sp=2, devices=jax.devices("cpu")[:4])
+    with pytest.raises(ValueError, match="gpipe"):
+        llama.build_train_step(cfg, topo, use_pp=True, schedule="1f1b")
