@@ -7,8 +7,13 @@ Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 """
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import _xla_cpu_flags  # noqa: E402 — repo-root helper, pre-jax
+
+_xla_cpu_flags.ensure(device_count=8)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 

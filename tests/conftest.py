@@ -12,10 +12,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # force: axon may be preset in env
 # block indefinitely when the tunnel is down — child processes would hang
 # at interpreter startup, surfacing as _queue.Empty test timeouts.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+import sys as _sys
+_sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import _xla_cpu_flags  # noqa: E402 — stdlib-only, pre-jax
+
+_xla_cpu_flags.ensure(device_count=8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
