@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, ReLU, MaxPool2D, Dropout,
                    Linear, AdaptiveAvgPool2D)
 from ...tensor.manipulation import flatten
+from ._utils import load_pretrained
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -38,4 +39,4 @@ class AlexNet(Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    return AlexNet(**kwargs)
+    return load_pretrained(AlexNet(**kwargs), "alexnet", pretrained)

@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
                    AvgPool2D, Linear, AdaptiveAvgPool2D, Dropout)
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201", "densenet264"]
@@ -86,7 +87,8 @@ class DenseNet(Layer):
 
 
 def _densenet(layers, pretrained=False, **kwargs):
-    return DenseNet(layers=layers, **kwargs)
+    return load_pretrained(DenseNet(layers=layers, **kwargs),
+                           f"densenet{layers}", pretrained)
 
 
 def densenet121(pretrained=False, **kwargs):

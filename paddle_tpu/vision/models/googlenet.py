@@ -5,6 +5,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, ReLU, MaxPool2D,
                    Dropout, Linear, AdaptiveAvgPool2D)
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["GoogLeNet", "googlenet"]
 
@@ -93,4 +94,4 @@ class GoogLeNet(Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    return GoogLeNet(**kwargs)
+    return load_pretrained(GoogLeNet(**kwargs), "googlenet", pretrained)

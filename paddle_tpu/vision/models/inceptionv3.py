@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
                    AvgPool2D, Dropout, Linear, AdaptiveAvgPool2D)
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["InceptionV3", "inception_v3"]
 
@@ -134,4 +135,5 @@ class InceptionV3(Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    return InceptionV3(**kwargs)
+    return load_pretrained(InceptionV3(**kwargs), "inception_v3",
+                           pretrained)

@@ -4,7 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, Linear,
                    AdaptiveAvgPool2D)
 from ...tensor.manipulation import flatten
-from ._utils import _make_divisible
+from ._utils import _make_divisible, load_pretrained
 
 __all__ = ["MobileNetV1", "mobilenet_v1"]
 
@@ -58,4 +58,5 @@ class MobileNetV1(Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV1(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV1(scale=scale, **kwargs),
+                           f"mobilenetv1_{float(scale)}", pretrained)

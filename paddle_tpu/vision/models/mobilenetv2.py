@@ -4,7 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU6, Linear,
                    Dropout, AdaptiveAvgPool2D)
 from ...tensor.manipulation import flatten
-from ._utils import _make_divisible
+from ._utils import _make_divisible, load_pretrained
 
 __all__ = ["MobileNetV2", "mobilenet_v2"]
 
@@ -72,4 +72,5 @@ class MobileNetV2(Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV2(scale=scale, **kwargs),
+                           f"mobilenetv2_{float(scale)}", pretrained)

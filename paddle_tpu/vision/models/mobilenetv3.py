@@ -4,7 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, Hardswish,
                    Hardsigmoid, Linear, Dropout, AdaptiveAvgPool2D)
 from ...tensor.manipulation import flatten
-from ._utils import _make_divisible
+from ._utils import _make_divisible, load_pretrained
 
 __all__ = ["MobileNetV3Small", "MobileNetV3Large",
            "mobilenet_v3_small", "mobilenet_v3_large"]
@@ -128,8 +128,10 @@ class MobileNetV3Large(MobileNetV3):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3Small(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV3Small(scale=scale, **kwargs),
+                           f"mobilenet_v3_small_x{float(scale)}", pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3Large(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV3Large(scale=scale, **kwargs),
+                           f"mobilenet_v3_large_x{float(scale)}", pretrained)

@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
                    AdaptiveAvgPool2D, Linear)
 from ...tensor.manipulation import flatten
+from ._utils import load_pretrained
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "resnext50_32x4d", "resnext50_64x4d",
@@ -135,65 +136,72 @@ class ResNet(Layer):
         return x
 
 
-def _resnet(block, depth, width=64, pretrained=False, **kwargs):
-    return ResNet(block, depth, width=width, **kwargs)
+def _resnet(arch, block, depth, width=64, pretrained=False, groups=1,
+            **kwargs):
+    model = ResNet(block, depth, width=width, groups=groups, **kwargs)
+    return load_pretrained(model, arch, pretrained)
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, pretrained=pretrained, **kwargs)
+    return _resnet("resnet18", BasicBlock, 18, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, pretrained=pretrained, **kwargs)
+    return _resnet("resnet34", BasicBlock, 34, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, pretrained=pretrained, **kwargs)
+    return _resnet("resnet50", BottleneckBlock, 50, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, pretrained=pretrained, **kwargs)
+    return _resnet("resnet101", BottleneckBlock, 101,
+                   pretrained=pretrained, **kwargs)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, pretrained=pretrained, **kwargs)
+    return _resnet("resnet152", BottleneckBlock, 152,
+                   pretrained=pretrained, **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=4, groups=32,
-                   pretrained=pretrained, **kwargs)
+    return _resnet("resnext50_32x4d", BottleneckBlock, 50, width=4,
+                   groups=32, pretrained=pretrained, **kwargs)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=4, groups=64,
-                   pretrained=pretrained, **kwargs)
+    return _resnet("resnext50_64x4d", BottleneckBlock, 50, width=4,
+                   groups=64, pretrained=pretrained, **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, width=4, groups=32,
-                   pretrained=pretrained, **kwargs)
+    return _resnet("resnext101_32x4d", BottleneckBlock, 101, width=4,
+                   groups=32, pretrained=pretrained, **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, width=4, groups=64,
-                   pretrained=pretrained, **kwargs)
+    return _resnet("resnext101_64x4d", BottleneckBlock, 101, width=4,
+                   groups=64, pretrained=pretrained, **kwargs)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, width=4, groups=32,
-                   pretrained=pretrained, **kwargs)
+    return _resnet("resnext152_32x4d", BottleneckBlock, 152, width=4,
+                   groups=32, pretrained=pretrained, **kwargs)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, width=4, groups=64,
-                   pretrained=pretrained, **kwargs)
+    return _resnet("resnext152_64x4d", BottleneckBlock, 152, width=4,
+                   groups=64, pretrained=pretrained, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=128, pretrained=pretrained,
-                   **kwargs)
+    return _resnet("wide_resnet50_2", BottleneckBlock, 50, width=128,
+                   pretrained=pretrained, **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, width=128, pretrained=pretrained,
-                   **kwargs)
+    return _resnet("wide_resnet101_2", BottleneckBlock, 101, width=128,
+                   pretrained=pretrained, **kwargs)

@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, Swish,
                    MaxPool2D, Linear, AdaptiveAvgPool2D, ChannelShuffle)
 from ...tensor.manipulation import concat, flatten, split
+from ._utils import load_pretrained
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
            "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
@@ -98,28 +99,35 @@ class ShuffleNetV2(Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.25, **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=0.25, **kwargs),
+                           "shufflenet_v2_x0_25", pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.33, **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=0.33, **kwargs),
+                           "shufflenet_v2_x0_33", pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=0.5, **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=0.5, **kwargs),
+                           "shufflenet_v2_x0_5", pretrained)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.0, **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=1.0, **kwargs),
+                           "shufflenet_v2_x1_0", pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.5, **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=1.5, **kwargs),
+                           "shufflenet_v2_x1_5", pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=2.0, **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=2.0, **kwargs),
+                           "shufflenet_v2_x2_0", pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+    return load_pretrained(ShuffleNetV2(scale=1.0, act="swish", **kwargs),
+                           "shufflenet_v2_swish", pretrained)

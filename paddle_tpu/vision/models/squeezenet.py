@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, ReLU, MaxPool2D, Dropout,
                    AdaptiveAvgPool2D)
 from ...tensor.manipulation import concat, flatten
+from ._utils import load_pretrained
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -63,8 +64,10 @@ class SqueezeNet(Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    return SqueezeNet("1.0", **kwargs)
+    return load_pretrained(SqueezeNet("1.0", **kwargs), "squeezenet1_0",
+                           pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    return SqueezeNet("1.1", **kwargs)
+    return load_pretrained(SqueezeNet("1.1", **kwargs), "squeezenet1_1",
+                           pretrained)

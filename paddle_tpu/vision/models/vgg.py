@@ -4,6 +4,7 @@ from __future__ import annotations
 from ...nn import (Layer, Sequential, Conv2D, BatchNorm2D, ReLU, MaxPool2D,
                    Dropout, Linear, AdaptiveAvgPool2D)
 from ...tensor.manipulation import flatten
+from ._utils import load_pretrained
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
@@ -57,7 +58,9 @@ class VGG(Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
-    return VGG(_make_features(_CFGS[cfg], batch_norm), **kwargs)
+    model = VGG(_make_features(_CFGS[cfg], batch_norm), **kwargs)
+    return load_pretrained(model, arch + ("_bn" if batch_norm else ""),
+                           pretrained)
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
