@@ -30,14 +30,9 @@ def main():
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    # 1. rendezvous through the native TCPStore
-    from paddle_tpu.distributed.store import TCPStore
-    store = TCPStore("127.0.0.1", store_port, is_master=(rank == 0),
-                     world_size=nprocs)
-    if rank == 0:
-        store.set("jax_coordinator", f"127.0.0.1:{coord_port}".encode())
-    coord = store.wait("jax_coordinator").decode()
-    os.environ["PADDLE_MASTER"] = coord
+    # 1. rendezvous through the native TCPStore (shared helper)
+    from _dist_rendezvous import rendezvous, ordered_exit
+    store = rendezvous(rank, nprocs, store_port, coord_port)
 
     # 2. gang bootstrap through the framework entry point
     import paddle_tpu.distributed as dist
@@ -115,26 +110,7 @@ def main():
     print("RESULT:" + json.dumps({
         "rank": rank, "world": nprocs, "allreduce": total,
         "allgather": got, "losses": losses}), flush=True)
-    store.barrier("done")
-    # ordered teardown: clients must be gone before the coordinator
-    # (rank 0) exits — a client whose PollForError thread outlives the
-    # coordinator fails with "Socket closed" after all checks already
-    # passed. jax.distributed.shutdown() itself can barrier against the
-    # coordinator, so clients just exit; rank 0 waits for their notice.
-    if rank != 0:
-        store.set(f"exiting{rank}", b"1")
-        store.close()
-    else:
-        import time
-        for r in range(1, nprocs):
-            store.wait(f"exiting{r}")
-        time.sleep(1.0)  # let client sockets actually close
-        store.close()
-    # skip C++ static destructors: the coordination-service threads can
-    # abort at interpreter shutdown after the checks already passed
-    sys.stdout.flush()
-    sys.stderr.flush()
-    os._exit(0)
+    ordered_exit(store, rank, nprocs)
 
 
 if __name__ == "__main__":

@@ -34,7 +34,8 @@ def _free_ports(n):
             s.close()
 
 
-def _launch_gang(nprocs, timeout=420):
+def _launch_gang(nprocs, timeout=420, worker="dist_worker.py",
+                 devices_per_proc=1):
     store_port, coord_port = _free_ports(2)
     procs = []
     for rank in range(nprocs):
@@ -42,15 +43,18 @@ def _launch_gang(nprocs, timeout=420):
         env.pop("PALLAS_AXON_POOL_IPS", None)  # gang is CPU-only
         env.pop("AXON_POOL_SVC_OVERRIDE", None)
         env["JAX_PLATFORMS"] = "cpu"
-        # one CPU device per process: the gang itself is the parallelism
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        # devices_per_proc=1: the gang itself is the parallelism;
+        # >1: multi-host GSPMD (n processes x m virtual devices each)
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            + str(devices_per_proc))
+        env["PTQ_DEVICES_PER_PROC"] = str(devices_per_proc)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         env["PADDLE_TRAINER_ID"] = str(rank)
         env["PADDLE_TRAINERS_NUM"] = str(nprocs)
         env["PTQ_STORE_PORT"] = str(store_port)
         env["PTQ_COORD_PORT"] = str(coord_port)
         procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests", "dist_worker.py")],
+            [sys.executable, os.path.join(REPO, "tests", worker)],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
     outs = []
@@ -86,3 +90,25 @@ def test_gang_collectives_and_dp_parity(nprocs):
     # and the worker itself asserted parity with the single-process run
     for a, b in zip(results, results[1:]):
         assert a["losses"] == b["losses"]
+
+
+def test_hybrid_mesh_across_process_boundary():
+    """Multi-host GSPMD: 2 processes x 4 virtual devices = one global
+    8-device mesh, with the pipeline (then the ring-attention) axis
+    spanning the process boundary. Each rank asserts CE parity against
+    its locally computed single-device reference (the worker raises on
+    mismatch); here we additionally require both ranks to agree."""
+    outs = _launch_gang(2, timeout=900, worker="hybrid_dist_worker.py",
+                        devices_per_proc=4)
+    results = []
+    for rc, out, err in outs:
+        assert rc == 0, (rc, out[-2000:], err[-2000:])
+        line = next(l for l in out.splitlines() if l.startswith("RESULT:"))
+        results.append(json.loads(line[len("RESULT:"):]))
+    assert sorted(r["rank"] for r in results) == [0, 1]
+    for r in results:
+        labels = [v["label"] for v in r["variants"]]
+        assert labels == ["pp-xproc", "cp-xproc"], labels
+    for a, b in zip(results, results[1:]):
+        for va, vb in zip(a["variants"], b["variants"]):
+            assert va["ce"] == vb["ce"], (va, vb)
