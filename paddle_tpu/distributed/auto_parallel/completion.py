@@ -99,12 +99,30 @@ class _Propagator:
         self.report.reshards.append(
             Reshard(prim, kind, axis, int(nbytes), float(cost)))
 
-    def _gather_to_replicated(self, prim, spec: Spec, aval) -> Spec:
-        """Record the all-gathers needed to fully replicate a value."""
+    def _local_bytes(self, aval, spec: Spec) -> int:
+        """Per-device shard bytes of a value under ``spec`` — the
+        payload convention shared with validate.hlo_collectives (what
+        one device actually puts on the wire)."""
+        n = _nbytes(aval)
         for ax in spec:
             if ax is not None:
-                self._record(prim, "all_gather",
-                             ax, _nbytes(aval) // self._axis_n(ax))
+                n //= self._axis_n(ax)
+        return n
+
+    def _record_gathers(self, prim, aval, full_spec: Spec, gather_axes):
+        """Record sequential all-gathers of ``gather_axes``: each gather
+        grows the per-device buffer, so later gathers move more bytes
+        (a 2-axis replicate is local + local*n1, not 2x local)."""
+        local = self._local_bytes(aval, full_spec)
+        for ax in gather_axes:
+            if ax is not None:
+                self._record(prim, "all_gather", ax, local)
+                local *= self._axis_n(ax)
+
+    def _gather_to_replicated(self, prim, spec: Spec, aval) -> Spec:
+        """Record the all-gathers needed to fully replicate a value."""
+        self._record_gathers(prim, aval, spec,
+                             [ax for ax in spec if ax is not None])
         return (None,) * len(spec)
 
     # -- per-primitive rules ------------------------------------------------
@@ -112,20 +130,31 @@ class _Propagator:
         """Same-shape operands: merge specs dim-wise; a conflict means
         one operand reshards (gather the smaller)."""
         out_ndim = len(out_avals[0].shape)
+        out_shape = tuple(out_avals[0].shape)
         merged: List[Optional[str]] = [None] * out_ndim
         for d in range(out_ndim):
+            # a size-1 operand dim broadcasts: it is replicated along d
+            # and contributes no sharding (softmax's x - max(keepdims))
             axes = {s[d] for s, a in zip(in_specs, in_avals)
-                    if len(a.shape) == out_ndim and s[d] is not None}
+                    if len(a.shape) == out_ndim and s[d] is not None
+                    and a.shape[d] == out_shape[d]}
             if len(axes) == 1:
                 merged[d] = axes.pop()
             elif len(axes) > 1:
-                # conflict: keep the majority/first, gather the others
-                keep = sorted(axes)[0]
+                # conflict: keep the axis backed by the most operand
+                # bytes (gathering the smaller side moves less data —
+                # GSPMD's merge heuristic), gather the rest
+                vol: Dict[str, int] = {}
+                for s, a in zip(in_specs, in_avals):
+                    if s[d] is not None:
+                        vol[s[d]] = vol.get(s[d], 0) \
+                            + self._local_bytes(a, s)
+                keep = max(sorted(vol), key=lambda ax: vol[ax])
                 merged[d] = keep
                 for s, a in zip(in_specs, in_avals):
                     if s[d] is not None and s[d] != keep:
                         self._record(prim, "all_gather", s[d],
-                                     _nbytes(a) // self._axis_n(s[d]))
+                                     self._local_bytes(a, s))
         return [tuple(merged)] * len(out_avals)
 
     def _rule_dot_general(self, prim, params, in_specs, in_avals,
@@ -135,24 +164,6 @@ class _Propagator:
         la, ra = in_avals
         out_ndim = len(out_avals[0].shape)
         out: List[Optional[str]] = [None] * out_ndim
-        # contracting dims: matching shard -> partial result (psum);
-        # one-sided shard -> gather that operand
-        for dl, dr in zip(lc, rc):
-            al, ar = ls[dl], rs[dr]
-            if al is not None and al == ar:
-                self._record(prim, "all_reduce", al,
-                             _nbytes(out_avals[0]))
-            elif al is not None and ar is None:
-                self._record(prim, "all_gather", al,
-                             _nbytes(la) // self._axis_n(al))
-            elif ar is not None and al is None:
-                self._record(prim, "all_gather", ar,
-                             _nbytes(ra) // self._axis_n(ar))
-            elif al is not None and ar is not None:
-                self._record(prim, "all_gather", al,
-                             _nbytes(la) // self._axis_n(al))
-                self._record(prim, "all_gather", ar,
-                             _nbytes(ra) // self._axis_n(ar))
         # output layout: batch dims, then left free, then right free
         pos = 0
         for dl, dr in zip(lb, rb):
@@ -166,6 +177,26 @@ class _Propagator:
             if d not in rc and d not in rb:
                 out[pos] = rs[d]
                 pos += 1
+        # contracting dims: matching shard -> partial result (psum of
+        # the per-device OUTPUT shard — free dims may themselves be
+        # sharded, e.g. a dp batch dim, shrinking the psum payload);
+        # one-sided shard -> gather that operand's local shard
+        for dl, dr in zip(lc, rc):
+            al, ar = ls[dl], rs[dr]
+            if al is not None and al == ar:
+                self._record(prim, "all_reduce", al,
+                             self._local_bytes(out_avals[0], tuple(out)))
+            elif al is not None and ar is None:
+                self._record(prim, "all_gather", al,
+                             self._local_bytes(la, ls))
+            elif ar is not None and al is None:
+                self._record(prim, "all_gather", ar,
+                             self._local_bytes(ra, rs))
+            elif al is not None and ar is not None:
+                self._record(prim, "all_gather", al,
+                             self._local_bytes(la, ls))
+                self._record(prim, "all_gather", ar,
+                             self._local_bytes(ra, rs))
         # model FLOPs: 2 * prod(out) * prod(contract)
         contract = int(np.prod([la.shape[d] for d in lc])) if lc else 1
         self.report.flops += 2.0 * float(np.prod(out_avals[0].shape)) \
@@ -175,14 +206,14 @@ class _Propagator:
     def _rule_reduce(self, prim, params, in_specs, in_avals, out_avals):
         axes = params.get("axes", ())
         spec = in_specs[0]
+        out = tuple(s for d, s in enumerate(spec) if d not in axes)
         for d in axes:
             if spec[d] is not None:
                 # any reduction over a sharded dim needs a cross-shard
-                # combine of the output payload (sum -> psum, max ->
-                # all-reduce-max, ... — same wire cost)
+                # combine of the per-device output shard (sum -> psum,
+                # max -> all-reduce-max, ... — same wire cost)
                 self._record(prim, "all_reduce", spec[d],
-                             _nbytes(out_avals[0]))
-        out = tuple(s for d, s in enumerate(spec) if d not in axes)
+                             self._local_bytes(out_avals[0], out))
         return [out]
 
     def _rule_transpose(self, prim, params, in_specs, in_avals, out_avals):
@@ -190,19 +221,68 @@ class _Propagator:
         return [tuple(in_specs[0][p] for p in perm)]
 
     def _rule_reshape(self, prim, params, in_specs, in_avals, out_avals):
-        """Keep leading-dim shardings that survive the reshape (dim size
-        preserved in order); anything else reshards to replicated."""
+        """Factor the reshape into groups of input/output dims with
+        equal products (the GSPMD propagation view of reshape):
+
+        - 1->1 group: the sharding carries over;
+        - 1->k split: the sharding lands on the FIRST sub-dim when the
+          axis size divides it (e.g. [B,S,H] -> [B,S,heads,hd] keeps an
+          'mp' shard of H on heads — the Megatron head split);
+        - k->1 merge: a shard of the group's leading dim carries to the
+          merged dim (contiguous blocks); shards of later dims cannot
+          be represented and reshard;
+        - general k->k: conservative gather.
+        """
         spec, a, o = in_specs[0], in_avals[0], out_avals[0]
-        out: List[Optional[str]] = [None] * len(o.shape)
-        for d in range(min(len(a.shape), len(o.shape))):
-            if a.shape[d] != o.shape[d]:
-                break  # copy spec while leading dims match
-            out[d] = spec[d]
-        lost = [s for i, s in enumerate(spec) if s is not None
-                and (i >= len(out) or out[i] != s)]
-        for ax in lost:
-            self._record(prim, "all_gather", ax,
-                         _nbytes(a) // self._axis_n(ax))
+        ishape, oshape = list(a.shape), list(o.shape)
+        out: List[Optional[str]] = [None] * len(oshape)
+        lost: List[str] = []
+        # size-1 dims carry no data and would mis-anchor the grouping
+        # ([1,B,H]->[B,H], [B,H]->[B,1,H] must keep shards with no
+        # collective): factor them out, group only the non-1 dims
+        ii = [d for d in range(len(ishape)) if ishape[d] != 1]
+        oo = [d for d in range(len(oshape)) if oshape[d] != 1]
+        i = j = 0
+        while i < len(ii) and j < len(oo):
+            i2, j2 = i + 1, j + 1
+            pi, pj = ishape[ii[i]], oshape[oo[j]]
+            while pi != pj and (i2 < len(ii) or j2 < len(oo)):
+                if pi < pj and i2 < len(ii):
+                    pi *= ishape[ii[i2]]
+                    i2 += 1
+                elif j2 < len(oo):
+                    pj *= oshape[oo[j2]]
+                    j2 += 1
+                else:
+                    break
+            n_in, n_out = i2 - i, j2 - j
+            if n_in == 1 and n_out == 1:
+                out[oo[j]] = spec[ii[i]]
+            elif n_in == 1 and n_out > 1:
+                ax = spec[ii[i]]
+                if ax is not None:
+                    if oshape[oo[j]] % self._axis_n(ax) == 0:
+                        out[oo[j]] = ax
+                    else:
+                        lost.append(ax)
+            elif n_out == 1:
+                ax = spec[ii[i]]
+                if ax is not None and ishape[ii[i]] % self._axis_n(ax) == 0:
+                    out[oo[j]] = ax
+                elif ax is not None:
+                    lost.append(ax)
+                for d in ii[i + 1:i2]:
+                    if spec[d] is not None:
+                        lost.append(spec[d])
+            else:  # general k->k regroup: conservative
+                for d in ii[i:i2]:
+                    if spec[d] is not None:
+                        lost.append(spec[d])
+            i, j = i2, j2
+        for d in ii[i:]:  # unmatched trailing non-1 input dims
+            if spec[d] is not None:
+                lost.append(spec[d])
+        self._record_gathers(prim, a, spec, lost)
         return [tuple(out)]
 
     # -- driver -------------------------------------------------------------
@@ -224,8 +304,8 @@ class _Propagator:
                         else np.asarray(v.val) for v in eqn.invars]
             out_avals = [v.aval for v in eqn.outvars]
 
-            if prim in ("pjit", "closed_call", "custom_jvp_call",
-                        "custom_vjp_call", "remat", "checkpoint",
+            if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                        "custom_vjp_call", "remat", "remat2", "checkpoint",
                         "custom_vjp_call_jaxpr"):
                 inner = eqn.params.get("jaxpr") or eqn.params.get(
                     "call_jaxpr")
@@ -257,7 +337,17 @@ class _Propagator:
         if prim == "reshape":
             return self._rule_reshape(prim, params, in_specs, in_avals,
                                       out_avals)
-        if prim in ("broadcast_in_dim", "convert_element_type", "copy",
+        if prim == "broadcast_in_dim" and in_specs:
+            # map the input spec through broadcast_dimensions; dims the
+            # broadcast expands (in size 1 -> out size n) are replicated
+            bd = params.get("broadcast_dimensions", ())
+            a, o = in_avals[0], out_avals[0]
+            out_spec: List[Optional[str]] = [None] * len(o.shape)
+            for i, d in enumerate(bd):
+                if i < len(a.shape) and a.shape[i] == o.shape[d]:
+                    out_spec[d] = in_specs[0][i]
+            return [tuple(out_spec)]
+        if prim in ("convert_element_type", "copy",
                     "stop_gradient", "integer_pow", "squeeze"):
             spec = in_specs[0] if in_specs else ()
             out = []
@@ -266,11 +356,16 @@ class _Propagator:
                     spec if len(o.shape) == len(in_avals[0].shape)
                     else None, len(o.shape)))
             return out
-        # same-shape (or scalar-broadcast) operands -> elementwise merge
-        if out_avals and all(
-                tuple(getattr(a, "shape", ())) in
-                (tuple(out_avals[0].shape), ())
-                for a in in_avals):
+        # same-shape, scalar, or size-1-broadcast operands ->
+        # elementwise merge
+        def _bcast_ok(a):
+            sh = tuple(getattr(a, "shape", ()))
+            osh = tuple(out_avals[0].shape)
+            if sh in (osh, ()):
+                return True
+            return len(sh) == len(osh) and all(
+                x == y or x == 1 for x, y in zip(sh, osh))
+        if out_avals and all(_bcast_ok(a) for a in in_avals):
             out_ndim = len(out_avals[0].shape)
             full = [_norm_spec(s if np.ndim(a) == out_ndim else None,
                                out_ndim)

@@ -1,0 +1,294 @@
+"""Validate completion.py's reshard predictions against GSPMD ground truth.
+
+Reference analog: the reference trusts its Completer/Resharder passes
+because they ARE the partitioner — what they decide is what runs
+(auto_parallel/completion.py:928, reshard.py). Here XLA's GSPMD is the
+partitioner, so the prediction layer (completion.propagate_sharding)
+needs an independent check: compile the same program with the same
+input shardings and compare the collectives XLA actually emitted
+(kind, mesh axis, payload bytes) against the PropagationReport.
+
+The comparison contract:
+- counts per collective kind must match exactly (an all-reduce XLA
+  combined from k logical reductions counts as its k operands);
+- total payload bytes per kind must agree within ``rtol``;
+- every predicted mesh axis must appear in the HLO's replica groups
+  (axis attribution), and vice versa.
+
+Payload convention (both sides): the PER-DEVICE operand bytes of the
+collective — for an all-gather that is the local shard being gathered,
+for an all-reduce the local partial-sum buffer. This is what the ring
+cost model's alpha-beta time actually moves over a link.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["HloCollective", "hlo_collectives", "compare_report",
+           "validate_propagation"]
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+# one collective-instruction definition line in optimized HLO, e.g.
+#   %all-reduce.3 = f32[4,64]{1,0} all-reduce(f32[4,64]{1,0} %p),
+#       channel_id=1, replica_groups={{0,4},{1,5}}, ...
+# async pairs appear as all-reduce-start / all-reduce-done: count the
+# -start (it carries operands + groups), skip the -done.
+_COLL_RE = re.compile(
+    r"=\s+(?P<result>\([^)]*\)|\S+)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?P<async>-start|-done)?\s*"
+    r"\((?P<operands>.*?)\)(?P<attrs>.*)$")
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+
+
+@dataclass
+class HloCollective:
+    kind: str                      # all_reduce / all_gather / ...
+    nbytes: int                    # summed per-device operand bytes
+    n_logical: int                 # operand count (combiner-merged ops)
+    axis: Optional[str]            # mesh axis inferred from groups
+    groups: Tuple[Tuple[int, ...], ...]
+
+    def __repr__(self):
+        return (f"HloCollective({self.kind} over {self.axis}, "
+                f"{self.nbytes} B, x{self.n_logical})")
+
+
+def _shape_bytes(text: str) -> int:
+    """Sum the bytes of every dtype[shape] occurrence in ``text``."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(text):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _parse_groups(attrs: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    """replica_groups in either explicit {{0,1},{2,3}} or iota
+    [g,s]<=[dims]T(perm) form -> tuple of device-id tuples."""
+    m = re.search(r"replica_groups=\{(\{[\d,{}\s]*\})\}", attrs)
+    if m:
+        groups = re.findall(r"\{([\d,\s]*)\}", m.group(1))
+        return tuple(tuple(int(x) for x in g.replace(" ", "").split(",")
+                           if x) for g in groups)
+    m = re.search(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+        attrs)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            ids = ids.transpose(perm)
+        return tuple(tuple(int(x) for x in row)
+                     for row in ids.reshape(g, s))
+    return None
+
+
+def _axis_groups(mesh) -> Dict[str, frozenset]:
+    """mesh axis name -> the set of device-id groups a collective over
+    exactly that axis uses (each group = ids varying along the axis
+    with every other axis coordinate fixed)."""
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    out = {}
+    for i, name in enumerate(mesh.axis_names):
+        moved = np.moveaxis(ids, i, -1).reshape(-1, ids.shape[i])
+        out[name] = frozenset(frozenset(int(x) for x in row)
+                              for row in moved)
+    return out
+
+
+def _infer_axis(groups, axis_map) -> Optional[str]:
+    if groups is None:
+        return None
+    gs = frozenset(frozenset(g) for g in groups)
+    for name, expect in axis_map.items():
+        if gs == expect:
+            return name
+    # a collective over a product of axes (or a sub-mesh) matches none
+    return None
+
+
+def hlo_collectives(fn, example_args, in_specs, mesh,
+                    out_specs=None) -> List[HloCollective]:
+    """Compile ``fn`` under GSPMD with the given input shardings and
+    return the collectives present in the optimized HLO."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def to_sharding(spec):
+        if isinstance(spec, NamedSharding):
+            return spec
+        if spec is None:
+            return NamedSharding(mesh, PartitionSpec())
+        if isinstance(spec, PartitionSpec):
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    flat_specs = jax.tree_util.tree_leaves(
+        in_specs, is_leaf=lambda x: x is None or isinstance(
+            x, (tuple, PartitionSpec, NamedSharding)))
+    flat_args, treedef = jax.tree_util.tree_flatten(example_args)
+    if len(flat_specs) != len(flat_args):
+        raise ValueError(f"in_specs ({len(flat_specs)} leaves) does not "
+                         f"match example_args ({len(flat_args)})")
+    in_sh = jax.tree_util.tree_unflatten(
+        treedef, [to_sharding(s) for s in flat_specs])
+    kw = {}
+    if out_specs is not None:
+        if isinstance(out_specs, list):  # several outputs -> fn returns
+            # a tuple; shardings must mirror that container type
+            kw["out_shardings"] = tuple(to_sharding(s) for s in out_specs)
+        else:
+            kw["out_shardings"] = to_sharding(out_specs)
+    compiled = jax.jit(fn, in_shardings=(in_sh if isinstance(
+        in_sh, tuple) else (in_sh,)), **kw).lower(*example_args).compile()
+    txt = compiled.as_text()
+
+    axis_map = _axis_groups(mesh)
+    out: List[HloCollective] = []
+    for line in txt.splitlines():
+        m = _COLL_RE.search(line)
+        if not m or m.group("async") == "-done":
+            continue
+        # operands are bare %refs in optimized HLO — bytes come from the
+        # RESULT shape (a tuple when the all-reduce combiner merged
+        # several logical reductions; each element is one logical op)
+        result = m.group("result")
+        nbytes = _shape_bytes(result)
+        n_logical = max(1, len(_SHAPE_RE.findall(result)))
+        groups = _parse_groups(m.group("attrs"))
+        n_group = len(groups[0]) if groups else 1
+        kind = m.group("op").replace("-", "_")
+        if kind == "all_gather" and n_group:
+            # result is the gathered buffer; the per-device operand
+            # shard (the payload convention) is 1/n of it
+            nbytes //= n_group
+        groups = groups or ()
+        out.append(HloCollective(
+            kind=kind, nbytes=nbytes, n_logical=n_logical,
+            axis=_infer_axis(groups, axis_map),
+            groups=groups))
+    return out
+
+
+def compare_report(report, hlo: Sequence[HloCollective],
+                   rtol: float = 0.3) -> Dict:
+    """Compare a PropagationReport against parsed HLO collectives.
+
+    Returns {"ok": bool, "mismatches": [...], "predicted": ..,
+    "actual": ..}. reduce-scatter+all-gather pairs XLA rewrites from a
+    logical all-reduce are folded back into one all_reduce when that
+    makes the counts line up.
+    """
+    def bucket_pred():
+        counts: Dict[str, int] = {}
+        bytes_: Dict[str, int] = {}
+        axes: Dict[str, set] = {}
+        for r in report.reshards:
+            counts[r.kind] = counts.get(r.kind, 0) + 1
+            bytes_[r.kind] = bytes_.get(r.kind, 0) + r.nbytes
+            axes.setdefault(r.kind, set()).add(r.axis)
+        return counts, bytes_, axes
+
+    def bucket_hlo(items):
+        counts: Dict[str, int] = {}
+        bytes_: Dict[str, int] = {}
+        axes: Dict[str, set] = {}
+        for c in items:
+            counts[c.kind] = counts.get(c.kind, 0) + c.n_logical
+            bytes_[c.kind] = bytes_.get(c.kind, 0) + c.nbytes
+            axes.setdefault(c.kind, set()).add(c.axis)
+        return counts, bytes_, axes
+
+    pc, pb, pa = bucket_pred()
+    ac, ab, aa = bucket_hlo(hlo)
+
+    # fold an XLA reduce-scatter(+matching all-gather) rewrite back into
+    # the logical all_reduce the predictor speaks in
+    if "reduce_scatter" in ac and "all_reduce" in pc \
+            and "reduce_scatter" not in pc:
+        rs = ac.pop("reduce_scatter")
+        ab_rs = ab.pop("reduce_scatter", 0)
+        ac["all_reduce"] = ac.get("all_reduce", 0) + rs
+        ab["all_reduce"] = ab.get("all_reduce", 0) + ab_rs
+        aa.setdefault("all_reduce", set()).update(
+            aa.pop("reduce_scatter", set()))
+        if "all_gather" in ac and "all_gather" not in pc:
+            ag = ac.pop("all_gather")
+            ab.pop("all_gather", 0)
+            aa.pop("all_gather", None)
+            ac["all_reduce"] = max(ac["all_reduce"] - 0, rs)  # same op
+            del ag
+
+    mismatches = []
+    for kind in sorted(set(pc) | set(ac)):
+        if pc.get(kind, 0) != ac.get(kind, 0):
+            mismatches.append(
+                f"{kind}: predicted {pc.get(kind, 0)} collectives, "
+                f"HLO has {ac.get(kind, 0)}")
+            continue
+        want, got = pb.get(kind, 0), ab.get(kind, 0)
+        if want and got and abs(want - got) > rtol * max(want, got):
+            mismatches.append(
+                f"{kind}: predicted {want} B, HLO moves {got} B "
+                f"(>{rtol:.0%} apart)")
+        pred_axes = {a for a in pa.get(kind, set()) if a is not None}
+        hlo_axes = {a for a in aa.get(kind, set()) if a is not None}
+        if pred_axes and hlo_axes and pred_axes != hlo_axes:
+            mismatches.append(
+                f"{kind}: predicted axes {sorted(pred_axes)}, "
+                f"HLO groups map to {sorted(hlo_axes)}")
+    return {
+        "ok": not mismatches, "mismatches": mismatches,
+        "predicted": {"counts": pc, "bytes": pb,
+                      "axes": {k: sorted(filter(None, v))
+                               for k, v in pa.items()}},
+        "actual": {"counts": ac, "bytes": ab,
+                   "axes": {k: sorted(filter(None, v))
+                            for k, v in aa.items()}},
+    }
+
+
+def validate_propagation(fn, example_args, in_specs, mesh,
+                         rtol: float = 0.3, use_out_specs: bool = True
+                         ) -> Dict:
+    """Run the predictor AND the compiler on the same sharded program
+    and compare. ``use_out_specs`` pins XLA's output shardings to the
+    predictor's inferred ones so the two sides answer the same
+    question (otherwise XLA is free to pick a different output layout
+    and the reshard sets legitimately differ)."""
+    from .completion import propagate_sharding
+
+    mesh_dims = dict(zip(mesh.axis_names,
+                         np.array(mesh.devices).shape))
+    report = propagate_sharding(fn, example_args, in_specs, mesh_dims)
+    out_specs = None
+    if use_out_specs:
+        outs = report.out_specs
+        # single output: the bare spec tuple; several: a LIST of spec
+        # tuples (a list so tree_map's tuple is_leaf hits each spec,
+        # not the container)
+        out_specs = outs[0] if len(outs) == 1 else list(outs)
+    hlo = hlo_collectives(fn, example_args, in_specs, mesh,
+                          out_specs=out_specs)
+    result = compare_report(report, hlo, rtol=rtol)
+    result["report"] = report
+    result["hlo"] = hlo
+    return result

@@ -37,3 +37,45 @@ def test_measure_record_check_cycle(tmp_path, monkeypatch):
     with open(op_bench.BASELINE, "w") as f:
         json.dump(book, f)
     assert op_bench.main(["--quick", "--check", "--ops", ops]) == 1
+
+    # --strict: a measured op with no recorded baseline fails the gate
+    # instead of slipping through as "skipped"
+    monkeypatch.setattr(op_bench, "THRESHOLD", 10.0)
+    assert op_bench.main(
+        ["--quick", "--check", "--ops", "softmax_ce"]) == 0  # lax: skip
+    assert op_bench.main(
+        ["--quick", "--check", "--strict", "--ops", "softmax_ce"]) == 1
+
+
+def test_llama_train_step_rung(tmp_path, monkeypatch):
+    """The end-to-end llama-step rung: measurable, recordable, gateable.
+
+    This is the tunnel-down perf backstop (tools/ci_model_benchmark.sh
+    analog): when bench.py cannot reach a TPU, this CPU rung still
+    catches a train step that got grossly slower. The committed
+    tools/op_bench_baseline.json carries the recorded number; here the
+    cycle runs against a fresh same-machine baseline so the test cannot
+    flake on cross-host speed differences.
+    """
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import op_bench
+
+    monkeypatch.setattr(op_bench, "BASELINE",
+                        str(tmp_path / "baseline.json"))
+    assert op_bench.main(
+        ["--quick", "--record", "--ops", "llama_train_step"]) == 0
+    with open(op_bench.BASELINE) as f:
+        book = json.load(f)
+    (key,) = book.keys()
+    ms = book[key]["llama_train_step"]
+    assert ms > 0
+    # gate passes immediately after on the same machine
+    monkeypatch.setattr(op_bench, "THRESHOLD", 10.0)
+    assert op_bench.main(
+        ["--quick", "--check", "--strict", "--ops", "llama_train_step"]) == 0
+    # a 100x-faster fabricated baseline trips it
+    book[key]["llama_train_step"] = ms / 100.0
+    with open(op_bench.BASELINE, "w") as f:
+        json.dump(book, f)
+    assert op_bench.main(
+        ["--quick", "--check", "--ops", "llama_train_step"]) == 1

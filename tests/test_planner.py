@@ -120,8 +120,11 @@ def test_completion_megatron_psum():
     ars = [r for r in rep.reshards if r.kind == "all_reduce"
            and r.axis == "mp"]
     assert len(ars) == 1, rep.reshards
-    # psum payload = the (batch, out) result of the second matmul
-    assert ars[0].nbytes == 8 * 64 * 4
+    # psum payload = the PER-DEVICE (batch/dp, out) shard of the second
+    # matmul's result — the batch dim is dp-sharded, so each device
+    # all-reduces half the global rows (matches the operand shape GSPMD
+    # actually emits; see validate.hlo_collectives)
+    assert ars[0].nbytes == 8 * 64 * 4 // 2
     # dp only appears for the scalar-loss reduce (no batch-dim psum of
     # a non-reduced tensor)
     gathers = [r for r in rep.reshards if r.kind == "all_gather"]

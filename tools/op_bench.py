@@ -101,6 +101,43 @@ def _cases(quick=False):
             return -jnp.mean(jnp.take_along_axis(ls, lb[:, None], 1))
         return jax.jit(f), (logits, labels)
 
+    def llama_train_step():
+        # End-to-end rung: the same smoke config bench.py runs off-TPU
+        # (vocab 1024 / hidden 256 / 4 layers / S 256 / B 2). Gating this
+        # one case catches gross train-step regressions even when the TPU
+        # tunnel is down and bench.py cannot record a real-chip number.
+        import functools
+
+        import optax
+
+        from paddle_tpu.models.llama import LlamaConfig, init_params, loss_fn
+
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=512,
+            dtype=jnp.float32, use_remat=False)
+        Bs, Ss = 2, 256
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, batch):
+            (_, ce), grads = jax.value_and_grad(
+                lambda p: loss_fn(cfg, p, batch), has_aux=True)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, ce
+
+        rng = np.random.default_rng(0)
+        batch = {
+            "input_ids": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (Bs, Ss)), jnp.int32),
+            "labels": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (Bs, Ss)), jnp.int32),
+        }
+        return functools.partial(step, params, opt_state), (batch,)
+
     return {
         "matmul_bf16": matmul,
         "flash_attention": flash_attention,
@@ -108,6 +145,7 @@ def _cases(quick=False):
         "embedding_gather": embedding_gather,
         "fused_adamw_update": fused_adamw_update,
         "softmax_ce": softmax_ce,
+        "llama_train_step": llama_train_step,
     }
 
 
@@ -143,6 +181,10 @@ def main(argv=None):
                     help="comma-separated case subset")
     ap.add_argument("--quick", action="store_true",
                     help="small shapes / fewer iters (harness smoke)")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --check: a measured op with no recorded "
+                         "baseline FAILS instead of being skipped, so new "
+                         "ops cannot slip past the gate un-recorded")
     args = ap.parse_args(argv)
 
     names = args.ops.split(",") if args.ops else None
@@ -164,10 +206,13 @@ def main(argv=None):
     if args.check:
         base = book.get(key, {})
         bad = []
+        missing = []
         for name, ms in results.items():
             ref = base.get(name)
             if ref is None:
-                print(f"{name}: no baseline for {key!r} (skipped)")
+                missing.append(name)
+                print(f"{name}: no baseline for {key!r} "
+                      f"({'FAIL (--strict)' if args.strict else 'skipped'})")
                 continue
             ratio = ms / ref
             status = "OK" if ratio <= THRESHOLD else "REGRESSION"
@@ -178,6 +223,10 @@ def main(argv=None):
         if bad:
             print(f"FAILED: {len(bad)} op(s) regressed >"
                   f"{(THRESHOLD - 1) * 100:.0f}%: {bad}")
+            return 1
+        if args.strict and missing:
+            print(f"FAILED (--strict): {len(missing)} op(s) have no "
+                  f"baseline for {key!r}: {missing}; run --record first")
             return 1
         print("all ops within threshold")
     return 0
