@@ -11,7 +11,8 @@ from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
                          get_rank, get_world_size, get_backend,
                          is_initialized, destroy_process_group, wait,
                          stream)
-from .parallel import (init_parallel_env, ParallelEnv, DataParallel)
+from .parallel import (init_parallel_env, shutdown, ParallelEnv,
+                       DataParallel)
 from .mesh import (HybridTopology, init_mesh, get_mesh, set_mesh,
                    get_topology, ProcessMesh, PartitionSpec, NamedSharding)
 from .shard import (shard_tensor, shard_op, shard_layer,
@@ -36,7 +37,8 @@ __all__ = [
     "irecv", "reduce_scatter", "barrier", "get_backend",
     "gloo_init_parallel_env", "shutdown_process_group", "split",
     "get_rank", "get_world_size", "is_initialized", "destroy_process_group",
-    "wait", "stream", "init_parallel_env", "ParallelEnv", "DataParallel",
+    "wait", "stream", "init_parallel_env", "shutdown", "ParallelEnv",
+    "DataParallel",
     "HybridTopology", "init_mesh", "get_mesh", "set_mesh", "get_topology",
     "ProcessMesh", "PartitionSpec", "NamedSharding", "shard_tensor",
     "shard_op", "shard_layer", "with_sharding_constraint", "shard_params",

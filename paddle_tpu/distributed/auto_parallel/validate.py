@@ -171,8 +171,15 @@ def hlo_collectives(fn, example_args, in_specs, mesh,
         # RESULT shape (a tuple when the all-reduce combiner merged
         # several logical reductions; each element is one logical op)
         result = m.group("result")
+        shapes = _SHAPE_RE.findall(result)
+        if m.group("async") == "-start" and len(shapes) == 2 \
+                and m.group("op") != "all-reduce":
+            # async gather/permute/a2a -start results echo the operand:
+            # (operand, result) — one logical op, count the result only
+            result = f"{shapes[-1][0]}[{shapes[-1][1]}]"
+            shapes = shapes[-1:]
         nbytes = _shape_bytes(result)
-        n_logical = max(1, len(_SHAPE_RE.findall(result)))
+        n_logical = max(1, len(shapes))
         groups = _parse_groups(m.group("attrs"))
         n_group = len(groups[0]) if groups else 1
         kind = m.group("op").replace("-", "_")
@@ -188,14 +195,53 @@ def hlo_collectives(fn, example_args, in_specs, mesh,
     return out
 
 
+def _fold_rs_ag(items: Sequence[HloCollective],
+                predicted_kinds) -> List[HloCollective]:
+    """Fold XLA's reduce-scatter(+matching all-gather) rewrite of a
+    logical all-reduce back into one all_reduce, so the comparison is
+    in the predictor's vocabulary. Only folds when the predictor spoke
+    no reduce_scatter itself; each RS consumes AT MOST ONE all-gather —
+    the one whose axis and per-device operand bytes match the RS's
+    scattered shard — so unrelated gathers still count (and still fail
+    the comparison when the predictor missed them). The folded
+    all_reduce's payload is the FULL per-device buffer (shard * group
+    size), matching the predictor's convention."""
+    if "reduce_scatter" in predicted_kinds or not any(
+            c.kind == "reduce_scatter" for c in items):
+        return list(items)
+    paired = set()
+    if "all_gather" not in predicted_kinds:
+        gathers = [c for c in items if c.kind == "all_gather"]
+        for c in items:
+            if c.kind != "reduce_scatter":
+                continue
+            mate = next(
+                (g for g in gathers if id(g) not in paired
+                 and g.axis == c.axis and g.nbytes == c.nbytes), None)
+            if mate is not None:
+                paired.add(id(mate))
+    out = []
+    for c in items:
+        if c.kind == "reduce_scatter":
+            n = len(c.groups[0]) if c.groups else 1
+            out.append(HloCollective(
+                kind="all_reduce", nbytes=c.nbytes * n,
+                n_logical=c.n_logical, axis=c.axis, groups=c.groups))
+        elif id(c) in paired:
+            continue  # the gather half of the rewrite
+        else:
+            out.append(c)
+    return out
+
+
 def compare_report(report, hlo: Sequence[HloCollective],
                    rtol: float = 0.3) -> Dict:
     """Compare a PropagationReport against parsed HLO collectives.
 
     Returns {"ok": bool, "mismatches": [...], "predicted": ..,
     "actual": ..}. reduce-scatter+all-gather pairs XLA rewrites from a
-    logical all-reduce are folded back into one all_reduce when that
-    makes the counts line up.
+    logical all-reduce are folded back into one all_reduce (see
+    _fold_rs_ag).
     """
     def bucket_pred():
         counts: Dict[str, int] = {}
@@ -218,24 +264,7 @@ def compare_report(report, hlo: Sequence[HloCollective],
         return counts, bytes_, axes
 
     pc, pb, pa = bucket_pred()
-    ac, ab, aa = bucket_hlo(hlo)
-
-    # fold an XLA reduce-scatter(+matching all-gather) rewrite back into
-    # the logical all_reduce the predictor speaks in
-    if "reduce_scatter" in ac and "all_reduce" in pc \
-            and "reduce_scatter" not in pc:
-        rs = ac.pop("reduce_scatter")
-        ab_rs = ab.pop("reduce_scatter", 0)
-        ac["all_reduce"] = ac.get("all_reduce", 0) + rs
-        ab["all_reduce"] = ab.get("all_reduce", 0) + ab_rs
-        aa.setdefault("all_reduce", set()).update(
-            aa.pop("reduce_scatter", set()))
-        if "all_gather" in ac and "all_gather" not in pc:
-            ag = ac.pop("all_gather")
-            ab.pop("all_gather", 0)
-            aa.pop("all_gather", None)
-            ac["all_reduce"] = max(ac["all_reduce"] - 0, rs)  # same op
-            del ag
+    ac, ab, aa = bucket_hlo(_fold_rs_ag(hlo, set(pc)))
 
     mismatches = []
     for kind in sorted(set(pc) | set(ac)):

@@ -105,7 +105,12 @@ def is_initialized():
 
 
 def destroy_process_group(group=None):
-    pass
+    """Destroying the global group tears down the gang (reference:
+    collective.destroy_process_group); named sub-groups are views over
+    the mesh with nothing to free."""
+    if group is None:
+        from .parallel import shutdown
+        shutdown()
 
 
 def barrier(group=None):

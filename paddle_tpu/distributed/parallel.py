@@ -25,10 +25,33 @@ from ..nn.layer.layers import Layer
 from .mesh import init_mesh, get_topology
 from .collective import all_reduce, get_rank, get_world_size
 
-__all__ = ["init_parallel_env", "ParallelEnv", "DataParallel",
+__all__ = ["init_parallel_env", "shutdown", "ParallelEnv", "DataParallel",
            "get_rank", "get_world_size"]
 
 _INITIALIZED = [False]
+
+
+def shutdown():
+    """Tear down the multi-process gang so a worker can exit 0 through
+    NORMAL interpreter shutdown — the inverse of init_parallel_env.
+
+    Reference analog: ProcessGroup destruction + tcp_store shutdown at
+    trainer exit. The jax coordination service orders the teardown
+    internally (its shutdown barrier holds the coordinator open until
+    every client has disconnected), so after this returns ``sys.exit(0)``
+    is safe; no ``os._exit`` escape hatch is needed. Idempotent, and
+    also works when the gang was bootstrapped with raw
+    ``jax.distributed.initialize`` instead of init_parallel_env.
+    """
+    _INITIALIZED[0] = False
+    try:
+        from jax._src.distributed import global_state as _state
+        if getattr(_state, "client", None) is None and \
+                getattr(_state, "service", None) is None:
+            return  # single-process or already shut down
+    except ImportError:  # private path moved: let shutdown() decide
+        pass
+    jax.distributed.shutdown()
 
 
 def init_parallel_env(strategy=None):

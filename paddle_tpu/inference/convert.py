@@ -68,6 +68,15 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
     for ext in (".pdmodel", ".pdiparams"):
         if not os.path.exists(src_prefix + ext):
             raise FileNotFoundError(src_prefix + ext)
+    if not os.path.exists(src_prefix + ".meta"):
+        # the re-export below traces the wrapper against the .meta's
+        # input_specs; without them it would fail later with a
+        # confusing arity/trace error — name the real problem up front
+        raise FileNotFoundError(
+            f"{src_prefix}.meta: conversion needs the source artifact's "
+            ".meta (input_specs) written by save_inference_model; "
+            "re-export the source model or pass a prefix that has all "
+            "three of .pdmodel/.pdiparams/.meta")
 
     with open(src_prefix + ".pdmodel", "rb") as f:
         exported = jexport.deserialize(f.read())
@@ -116,11 +125,14 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
         return exported.call(rebuild(p), *xs)
 
     # input specs: everything after the weights keeps its exported aval
-    meta = {}
+    # (.meta existence checked up front with the other artifact files)
     meta_path = src_prefix + ".meta"
-    if os.path.exists(meta_path):
-        with open(meta_path, "rb") as f:
-            meta = pickle.load(f)
+    with open(meta_path, "rb") as f:
+        meta = pickle.load(f)
+    if not meta.get("input_specs"):
+        raise ValueError(
+            f"{meta_path} has no input_specs; the source artifact "
+            "predates spec-carrying save_inference_model — re-export it")
     # keep the source artifact's shape polymorphism: dynamic dims
     # re-export with ONE shared symbol per axis position (the
     # save_inference_model rule); fall back to baked shapes — and a

@@ -340,16 +340,36 @@ class DataLoader:
                             if i not in reorder)
                     if stalled and (shm is None or shm.qsize() == 0):
                         # grace drain: the dying worker may have flushed
-                        # its batch into the pipe first
-                        try:
-                            return data_queue.get(timeout=1.0)
-                        except queue_mod.Empty:
-                            dw = [workers[i] for i in sorted(dead)]
-                            raise RuntimeError(
-                                "DataLoader worker(s) "
-                                f"{[w.pid for w in dw]} exited unexpectedly "
-                                f"(exitcodes {[w.exitcode for w in dw]}) "
-                                "with batches still pending") from None
+                        # its batch into the pipe first. A large batch
+                        # (or a loaded host) can take several seconds to
+                        # land, so drain over a window — a single 1s get
+                        # aborted recoverable epochs. The user's timeout
+                        # stays authoritative: the window never extends
+                        # past `deadline`.
+                        grace_end = time.monotonic() + min(
+                            self.timeout or 5.0, 10.0)
+                        if deadline is not None:
+                            grace_end = min(grace_end, deadline)
+                        while True:
+                            try:
+                                return data_queue.get(timeout=0.5)
+                            except queue_mod.Empty:
+                                if time.monotonic() < grace_end:
+                                    continue
+                                if deadline is not None and \
+                                        time.monotonic() > deadline:
+                                    raise TimeoutError(
+                                        f"DataLoader timed out after "
+                                        f"{self.timeout}s waiting for a "
+                                        "worker batch (worker(s) "
+                                        f"{sorted(dead)} dead)") from None
+                                dw = [workers[i] for i in sorted(dead)]
+                                raise RuntimeError(
+                                    "DataLoader worker(s) "
+                                    f"{[w.pid for w in dw]} exited "
+                                    "unexpectedly (exitcodes "
+                                    f"{[w.exitcode for w in dw]}) "
+                                    "with batches still pending") from None
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutError(
                         f"DataLoader timed out after {self.timeout}s "

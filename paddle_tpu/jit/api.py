@@ -72,7 +72,14 @@ class StaticFunction:
 
         self._converted_fn = fn
         self._donate_argnums = donate_argnums
-        self._jit_cache: Dict[Any, Any] = {}
+        # LRU-bounded: keyed by static-leaf VALUES, so a per-call python
+        # scalar (step counter, temperature) would otherwise retain a
+        # compiled closure per distinct value forever
+        from collections import OrderedDict
+        self._jit_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        self._jit_cache_cap = int(os.environ.get(
+            "PADDLE_TPU_JIT_CACHE_SIZE", "128"))
+        self._jit_cache_warned = False
 
         def array_fn(*arrays, **kw):
             tensors = _tree_to_tensors(arrays)
@@ -118,6 +125,8 @@ class StaticFunction:
             # unhashable static leaf: no caching, direct trace each call
             key = None
         jitted = self._jit_cache.get(key) if key is not None else None
+        if jitted is not None:
+            self._jit_cache.move_to_end(key)
         if jitted is None:
             fn = self._converted_fn
             n_leaves = len(flat)
@@ -154,6 +163,19 @@ class StaticFunction:
             jitted = jax.jit(call_with_static, donate_argnums=donate)
             if key is not None:
                 self._jit_cache[key] = jitted
+                if len(self._jit_cache) > self._jit_cache_cap:
+                    self._jit_cache.popitem(last=False)
+                    if not self._jit_cache_warned:
+                        self._jit_cache_warned = True
+                        import warnings
+                        warnings.warn(
+                            f"to_static cache for "
+                            f"{getattr(self._fn, '__qualname__', self._fn)}"
+                            f" exceeded {self._jit_cache_cap} entries and "
+                            "is evicting (LRU). A python scalar arg that "
+                            "changes every call recompiles every call — "
+                            "pass it as a Tensor, or raise "
+                            "PADDLE_TPU_JIT_CACHE_SIZE.")
         dyn_arrays = [_as_array(flat[i]) for i in dyn_idx]
         out = jitted(*dyn_arrays)
         return _tree_to_tensors(out)

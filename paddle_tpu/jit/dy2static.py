@@ -259,17 +259,31 @@ def _any_return(stmts) -> bool:
     return False
 
 
-def _count_returning_ifs(stmts) -> int:
-    """How many if statements (recursively) contain an early return —
-    bounds the else-absorption duplication in _lower_returns."""
+def _lowered_volume(seq, budget: int) -> int:
+    """Estimate how many statements _lower_returns' tail duplication
+    would emit for ``seq`` — by mirroring process()'s recursion with
+    counts instead of nodes. Nested returning-ifs duplicate their tail
+    into BOTH arms, so the true cost is exponential in nesting depth; a
+    flat count of returning ifs bounds the count, not the 2^depth
+    blow-up. Clamped: any subtree pushing past ``budget`` returns
+    ``budget + 1`` immediately, so the estimate itself stays O(budget).
+    """
     n = 0
-    for st in stmts:
-        if isinstance(st, ast.If):
-            if _any_return(st.body) or _any_return(st.orelse):
-                n += 1
-            n += _count_returning_ifs(st.body)
-            n += _count_returning_ifs(st.orelse)
-    return n
+    for i, st in enumerate(seq):
+        if n > budget:
+            return n
+        if isinstance(st, ast.Return):
+            return n + 1
+        if isinstance(st, ast.If) and (_any_return(st.body)
+                                       or _any_return(st.orelse)):
+            rest = seq[i + 1:]
+            b = _lowered_volume(list(st.body) + rest, budget - n)
+            if n + b > budget:
+                return budget + 1
+            e = _lowered_volume(list(st.orelse) + rest, budget - n - b)
+            return n + 1 + b + e
+        n += 1
+    return n + 1
 
 
 def _return_in_ifs(stmts) -> bool:
@@ -827,11 +841,14 @@ def convert_to_static(fn: Callable) -> Callable:
     # assignments to one value name, the rewriter below can thread those
     # ifs through lax.cond like any other branch assignment
     # Guard-style returns (body returns immediately) duplicate nothing;
-    # the worst case (deep returns in BOTH arms) doubles the tail per
-    # returning if, so cap how many we absorb before falling back to
-    # unconverted (python) semantics for the whole function.
+    # deep returns in BOTH arms double the tail per nesting level, so
+    # cap the ESTIMATED EMITTED VOLUME (not the flat count of returning
+    # ifs — 8 shallow guards are fine, 8 nested both-arm returns would
+    # be ~256x tail copies) before falling back to unconverted (python)
+    # semantics for the whole function.
     lowered_returns = False
-    if _return_in_ifs(fdef.body) and _count_returning_ifs(fdef.body) <= 8:
+    if _return_in_ifs(fdef.body) and \
+            _lowered_volume(fdef.body, 512) <= 512:
         fdef.body = _lower_returns(fdef.body, "__pt_retval")
         lowered_returns = True
 

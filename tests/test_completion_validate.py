@@ -144,6 +144,35 @@ def test_reshape_split_keeps_sharding_no_collective(mesh):
     assert not res["predicted"]["counts"], res["report"].reshards
 
 
+def test_fold_rs_ag_semantics():
+    """The reduce-scatter+all-gather fold must (a) rescale the RS shard
+    bytes back to the full all-reduce buffer, (b) consume only the ONE
+    matching gather, and (c) leave unrelated gathers to fail the
+    comparison — no false pass when the predictor missed a reshard."""
+    from paddle_tpu.distributed.auto_parallel.validate import (
+        HloCollective, _fold_rs_ag)
+
+    g4 = ((0, 1, 2, 3),)
+    rs = HloCollective("reduce_scatter", nbytes=256, n_logical=1,
+                       axis="mp", groups=g4)
+    pair = HloCollective("all_gather", nbytes=256, n_logical=1,
+                         axis="mp", groups=g4)
+    unrelated = HloCollective("all_gather", nbytes=64, n_logical=1,
+                              axis="dp", groups=((0, 4),))
+    folded = _fold_rs_ag([rs, pair, unrelated], {"all_reduce"})
+    kinds = sorted(c.kind for c in folded)
+    assert kinds == ["all_gather", "all_reduce"], folded
+    ar = next(c for c in folded if c.kind == "all_reduce")
+    assert ar.nbytes == 256 * 4  # shard x group size = full buffer
+    keep = next(c for c in folded if c.kind == "all_gather")
+    assert keep.axis == "dp"  # the unrelated gather SURVIVES the fold
+
+    # when the predictor itself spoke reduce_scatter, nothing folds
+    same = _fold_rs_ag([rs, pair], {"reduce_scatter", "all_gather"})
+    assert sorted(c.kind for c in same) == ["all_gather",
+                                            "reduce_scatter"]
+
+
 def test_reshape_merge_trailing_shard_gathers(mesh):
     """[B, a, b] -> [B, a*b] with b (the trailing sub-dim) sharded:
     that layout is not representable after the merge — both sides must

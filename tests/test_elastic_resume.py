@@ -97,8 +97,10 @@ for _ in range(STEPS):
 np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5, atol=1e-7)
 print(f"RESULT rank={rank} restart={restart} loss={float(loss):.8f}",
       flush=True)
+import paddle_tpu.distributed as dist
+dist.shutdown()  # clean gang teardown: exit 0 via normal interpreter exit
 sys.stdout.flush()
-os._exit(0)
+sys.exit(0)
 """
 
 
