@@ -172,12 +172,13 @@ def hlo_collectives(fn, example_args, in_specs, mesh,
         # several logical reductions; each element is one logical op)
         result = m.group("result")
         shapes = _SHAPE_RE.findall(result)
-        if m.group("async") == "-start" and len(shapes) == 2 \
+        if m.group("async") == "-start" and len(shapes) % 2 == 0 \
                 and m.group("op") != "all-reduce":
-            # async gather/permute/a2a -start results echo the operand:
-            # (operand, result) — one logical op, count the result only
-            result = f"{shapes[-1][0]}[{shapes[-1][1]}]"
-            shapes = shapes[-1:]
+            # async gather/permute/a2a -start results echo the operands:
+            # ((op...), (result...)) — k logical ops with 2k shapes;
+            # keep the result half only (counts AND bytes)
+            shapes = shapes[len(shapes) // 2:]
+            result = " ".join(f"{dt}[{dims}]" for dt, dims in shapes)
         nbytes = _shape_bytes(result)
         n_logical = max(1, len(shapes))
         groups = _parse_groups(m.group("attrs"))

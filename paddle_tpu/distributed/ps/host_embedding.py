@@ -115,6 +115,14 @@ def shard_nbytes(key: str) -> int:
     return _SHARDS[key].nbytes
 
 
+def shard_state_dict(key: str):
+    return _SHARDS[key].state_dict()
+
+
+def shard_load_state_dict(key: str, sd) -> None:
+    _SHARDS[key].load_state_dict(sd)
+
+
 class HostEmbedding:
     """Sharded host-RAM embedding with device-side lookup/push.
 
@@ -142,9 +150,13 @@ class HostEmbedding:
         self.n_shards = int(n_shards)
         self.dtype = np.dtype(dtype)
         self.name = name
+        self._optimizer = optimizer
+        self._lr = float(lr)
+        self._seed = int(seed)
         self._rpc_workers = list(rpc_workers) if rpc_workers else None
-        rows_per = [len(range(s, self.num_embeddings, self.n_shards))
-                    for s in range(self.n_shards)]
+        self._rows_per = [len(range(s, self.num_embeddings, self.n_shards))
+                          for s in range(self.n_shards)]
+        rows_per = self._rows_per
         self._local: List[Optional[EmbeddingShard]] = []
         if self._rpc_workers is None:
             for s in range(self.n_shards):
@@ -152,13 +164,8 @@ class HostEmbedding:
                     rows_per[s], embedding_dim, optimizer=optimizer, lr=lr,
                     seed=seed + s, dtype=self.dtype))
         else:
-            from .. import rpc
             for s in range(self.n_shards):
-                w = self._rpc_workers[s % len(self._rpc_workers)]
-                rpc.rpc_sync(w, create_shard, args=(
-                    f"{name}/shard{s}", rows_per[s], embedding_dim),
-                    kwargs=dict(optimizer=optimizer, lr=lr, seed=seed + s,
-                                dtype=self.dtype))
+                self._create_remote_shard(s)
         if device_budget_bytes is not None \
                 and self.table_nbytes <= device_budget_bytes:
             import warnings
@@ -171,7 +178,17 @@ class HostEmbedding:
 
     # -- shard plane --------------------------------------------------------
     _RPC_FNS = {"lookup": shard_lookup, "push": shard_push,
-                "nbytes": shard_nbytes}
+                "nbytes": shard_nbytes, "state_dict": shard_state_dict,
+                "load_state_dict": shard_load_state_dict}
+
+    def _create_remote_shard(self, s: int) -> None:
+        from .. import rpc
+        w = self._rpc_workers[s % len(self._rpc_workers)]
+        rpc.rpc_sync(w, create_shard, args=(
+            f"{self.name}/shard{s}", self._rows_per[s],
+            self.embedding_dim),
+            kwargs=dict(optimizer=self._optimizer, lr=self._lr,
+                        seed=self._seed + s, dtype=self.dtype))
 
     def _shard_call(self, s: int, method: str, *args):
         if self._rpc_workers is None:
@@ -300,18 +317,99 @@ class HostEmbedding:
                 "invokes the backward that pushes the row gradients")
         return self._fn(ids, token)
 
+    def _shard_call_all(self, method: str, args_of=None):
+        """Fan the same method out to every shard; rpc mode issues all
+        calls concurrently (rpc_async) — a sequential gather would
+        serialize n_shards full-table DCN transfers."""
+        args_of = args_of or (lambda s: ())
+        if self._rpc_workers is None:
+            return [self._shard_call(s, method, *args_of(s))
+                    for s in range(self.n_shards)]
+        from .. import rpc
+        futs = []
+        for s in range(self.n_shards):
+            w = self._rpc_workers[s % len(self._rpc_workers)]
+            futs.append(rpc.rpc_async(
+                w, self._RPC_FNS[method],
+                args=(f"{self.name}/shard{s}", *args_of(s))))
+        return [f.result() for f in futs]
+
     # -- checkpoint ---------------------------------------------------------
+    # reference: memory_sparse_table.cc Save/Load — the PS persists its
+    # tables and a restarted shard holder reloads its slice. rpc mode
+    # gathers/scatters each shard's state over the rpc plane.
     def state_dict(self):
-        if self._rpc_workers is not None:
-            raise NotImplementedError(
-                "rpc-mode checkpoint: call state_dict on the shard "
-                "holders (EmbeddingShard.state_dict) per worker")
-        return {f"shard{s}": self._local[s].state_dict()
-                for s in range(self.n_shards)}
+        states = self._shard_call_all("state_dict")
+        return {f"shard{s}": states[s] for s in range(self.n_shards)}
 
     def load_state_dict(self, sd):
-        if self._rpc_workers is not None:
-            raise NotImplementedError(
-                "rpc-mode checkpoint: load on the shard holders")
+        self._shard_call_all("load_state_dict",
+                             lambda s: (sd[f"shard{s}"],))
+
+    def _shard_file(self, dirname: str, s: int) -> str:
+        import os
+        safe = self.name.replace("/", "_")
+        return os.path.join(dirname, f"{safe}.shard{s}.npz")
+
+    def save(self, dirname: str) -> None:
+        """Persist every shard to ``dirname`` (one .npz per shard), from
+        whichever holder owns it. Written atomically (tmp + rename) so a
+        crash mid-save never leaves a torn shard file."""
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        states = self._shard_call_all("state_dict")
         for s in range(self.n_shards):
-            self._local[s].load_state_dict(sd[f"shard{s}"])
+            sd = states[s]
+            path = self._shard_file(dirname, s)
+            tmp = path + ".tmp"
+            arrays = {"table": sd["table"],
+                      "optimizer": np.asarray(sd["optimizer"]),
+                      "lr": np.asarray(sd["lr"], np.float64)}
+            if "accum" in sd:
+                arrays["accum"] = sd["accum"]
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+
+    def _load_shard_sd(self, dirname: str, s: int):
+        import os
+        path = self._shard_file(dirname, s)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{path}: no checkpoint for shard {s} of "
+                f"{self.name!r}; was save() called with this dirname?")
+        with np.load(path) as z:
+            sd = {"table": z["table"], "optimizer": str(z["optimizer"]),
+                  "lr": float(z["lr"])}
+            if "accum" in z:
+                sd["accum"] = z["accum"]
+        return sd
+
+    def load(self, dirname: str) -> None:
+        """Reload every shard from a save() directory."""
+        sds = [self._load_shard_sd(dirname, s)
+               for s in range(self.n_shards)]
+        self._shard_call_all("load_state_dict", lambda s: (sds[s],))
+
+    def restore_shard(self, s: int, dirname: str) -> None:
+        """Recover ONE shard after its holder crashed and rejoined: re-
+        create the shard on the (restarted) worker that owns slot ``s``
+        and reload its slice from the save() directory. rpc endpoints
+        must be refreshed first (``rpc.refresh_worker_infos()``) so the
+        worker name resolves to the new process.
+
+        The recovery contract is the reference PS's: state since the
+        last save() is lost for this shard (async-SGD tolerates it);
+        every other shard is untouched.
+        """
+        if self._rpc_workers is None:
+            # local shards share the process's lifetime; reconstruct in
+            # place for API symmetry
+            self._local[s] = EmbeddingShard(
+                self._rows_per[s], self.embedding_dim,
+                optimizer=self._optimizer, lr=self._lr,
+                seed=self._seed + s, dtype=self.dtype)
+        else:
+            self._create_remote_shard(s)
+        self._shard_call(s, "load_state_dict",
+                         self._load_shard_sd(dirname, s))

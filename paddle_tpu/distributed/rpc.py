@@ -28,8 +28,9 @@ from typing import Dict, Optional
 from .store import TCPStore
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
-           "get_worker_info", "get_all_worker_infos",
-           "get_current_worker_info", "WorkerInfo"]
+           "refresh_worker_infos", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info",
+           "WorkerInfo"]
 
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
@@ -212,6 +213,26 @@ def shutdown():
     _state["client_pool"].shutdown(wait=False)
     _state.update(listener=None, thread=None, pool=None, client_pool=None,
                   store=None, infos={}, self=None)
+
+
+def refresh_worker_infos():
+    """Re-read the endpoint directory from the master store.
+
+    A worker that crashed and rejoined (init_rpc with its old name/rank)
+    re-registers at a NEW (ip, port); peers holding the old endpoint
+    would keep dialing the dead socket. Call this after the replacement
+    has rejoined, then retry — the reference's brpc channels re-resolve
+    PS endpoints the same way on server restart.
+    """
+    store = _state["store"]
+    if store is None:
+        raise RuntimeError("init_rpc must be called first")
+    infos = {}
+    for r in range(len(_state["infos"])):
+        info = WorkerInfo(*pickle.loads(store.wait(f"rpc/{r}")))
+        infos[info.name] = info
+    _state["infos"] = infos
+    return get_all_worker_infos()
 
 
 def get_worker_info(name) -> Optional[WorkerInfo]:

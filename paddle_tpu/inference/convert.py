@@ -129,7 +129,9 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
     meta_path = src_prefix + ".meta"
     with open(meta_path, "rb") as f:
         meta = pickle.load(f)
-    if not meta.get("input_specs"):
+    if "input_specs" not in meta:
+        # an EMPTY list is legitimate (weights-only artifact); only a
+        # .meta that never carried specs is unusable
         raise ValueError(
             f"{meta_path} has no input_specs; the source artifact "
             "predates spec-carrying save_inference_model — re-export it")

@@ -200,6 +200,18 @@ def main(argv=None):
     host = platform.node()
 
     if args.record:
+        prev = book.get(key, {})
+        prev_host = prev.get("__host__")
+        survivors = set(prev) - set(results) - {"__host__"}
+        if prev_host is not None and prev_host != host and survivors:
+            # merging would relabel host-A wall-clocks as host-B's and
+            # gate them at the strict same-host threshold
+            raise SystemExit(
+                f"refusing partial --record: {key!r} was recorded on "
+                f"{prev_host!r} and ops {sorted(survivors)} would keep "
+                f"its numbers under this host's ({host!r}) label. "
+                "Re-record ALL ops (drop --ops) or delete the key from "
+                f"{BASELINE} first.")
         book.setdefault(key, {}).update(results)
         book[key]["__host__"] = host
         with open(BASELINE, "w") as f:
