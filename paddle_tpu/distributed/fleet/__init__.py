@@ -52,7 +52,19 @@ class DistributedStrategy:
         self.sharding_configs = {}
         self.lamb = False
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "epsilon": 0.0,
+                             "exclude_from_weight_decay": []}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        # DGC (deep gradient compression) is a reasoned non-goal on TPU:
+        # it trades compute for bandwidth on commodity interconnects,
+        # while ICI all-reduces are compiler-scheduled, overlapped with
+        # backward compute, and not the bottleneck the strategy exists
+        # for. distributed_optimizer raises if enabled.
         self.dgc = False
+        self.dgc_configs = {}
         self.find_unused_parameters = False
         self.gradient_scale_configs = {"scale_strategy": "avg"}
 
@@ -94,7 +106,50 @@ def distributed_optimizer(optimizer, strategy=None):
     gradient_merge strategy (meta_optimizers/gradient_merge_optimizer)
     wraps it in k-step accumulation."""
     strategy = strategy or _FLEET_STATE.get("strategy")
-    if strategy is not None and getattr(strategy, "gradient_merge", False):
+    if strategy is None:
+        return optimizer
+    if getattr(strategy, "dgc", False):
+        raise NotImplementedError(
+            "DGC is a reasoned non-goal on TPU: gradient compression "
+            "trades compute for bandwidth on commodity interconnects; "
+            "ICI all-reduces are compiler-scheduled and overlapped with "
+            "backward compute. Use gradient_merge or localsgd to cut "
+            "synchronization frequency instead.")
+    if getattr(strategy, "lars", False):
+        # reference lars meta-optimizer: swap a Momentum inner optimizer
+        # for LarsMomentum with the strategy's coefficients, forwarding
+        # the inner optimizer's own regularization (the reference passes
+        # regularization=opt.regularization through)
+        from ...optimizer import LarsMomentum, Momentum
+        if isinstance(optimizer, Momentum):
+            cfg = getattr(strategy, "lars_configs", {}) or {}
+            if getattr(optimizer, "_nesterov", False):
+                import warnings
+                warnings.warn(
+                    "strategy.lars replaces Momentum with LarsMomentum, "
+                    "which (like the reference lars_momentum kernel) has "
+                    "no nesterov variant; use_nesterov is dropped")
+            lars = LarsMomentum(
+                learning_rate=optimizer._lr,
+                momentum=optimizer._momentum,
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+                lars_weight_decay=float(
+                    cfg.get("lars_weight_decay", 0.0005)),
+                epsilon=float(cfg.get("epsilon", 0.0)),
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", []))
+            # the Momentum's additive L2 survives alongside the
+            # in-ratio lars decay (base-class decay path)
+            lars._weight_decay = optimizer._weight_decay
+            optimizer = lars
+    if getattr(strategy, "localsgd", False):
+        from .localsgd import LocalSGDOptimizer
+        cfg = getattr(strategy, "localsgd_configs", {}) or {}
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)))
+    if getattr(strategy, "gradient_merge", False):
         from .gradient_merge import GradientMergeOptimizer
         cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
         return GradientMergeOptimizer(

@@ -1,0 +1,98 @@
+"""LocalSGD meta-optimizer: k local steps, then parameter averaging.
+
+Reference analog: fleet/meta_optimizers/localsgd_optimizer.py
+(LocalSGDOptimizer — trainers run k_steps of UN-synchronized SGD on
+their local batch shard, then all-reduce-average the PARAMETERS; the
+per-step gradient all-reduce of plain DP disappears, trading a little
+convergence noise for k-fold less communication).
+
+TPU-native: two surfaces.
+
+1. ``localsgd_round(train_step, k_steps, axis)`` — the compiled form:
+   wraps a per-replica functional train step into one round = a
+   ``lax.scan`` of k local steps (no collectives inside) followed by a
+   single ``pmean`` of the params over the dp axis. Run it under
+   ``shard_map`` with the params given a leading per-replica dimension;
+   XLA compiles the whole round onto ICI with exactly one all-reduce
+   per k steps.
+
+2. ``LocalSGDOptimizer(inner, k_steps)`` — the eager facade with the
+   reference's class shape: every step applies the inner optimizer
+   locally; each k-th step averages the parameters over the dp group
+   (identity on one process, ``lax.pmean`` inside a trace — same
+   contract as the rest of distributed.collective's eager facade).
+
+The adaptive variant (AdaptiveLocalSGDOptimizer, which retunes k from
+loss variance) is intentionally out of scope: its schedule is python-
+side control flow retuning a compile-time constant; retrace cost on TPU
+would eat the communication win. DGC (deep gradient compression) is
+likewise out of scope as a strategy: it targets bandwidth-starved
+commodity clusters, while ICI all-reduce is compiler-scheduled and
+overlapped — documented in DistributedStrategy.
+"""
+from __future__ import annotations
+
+__all__ = ["localsgd_round", "LocalSGDOptimizer"]
+
+
+def localsgd_round(train_step, k_steps: int, axis: str = "dp"):
+    """Build the compiled one-round function.
+
+    ``train_step(params, batch) -> (params, aux)`` must be collective-
+    free (pure local SGD). Returns ``round_fn(params, batches)`` where
+    ``batches`` stacks k local microbatches on a leading axis; the
+    result's params are pmean'd over ``axis``.
+    """
+    import jax
+    from jax import lax
+
+    if k_steps < 1:
+        raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+
+    def round_fn(params, batches):
+        def body(p, b):
+            return train_step(p, b)
+        params, auxs = lax.scan(body, params, batches, length=k_steps)
+        params = jax.tree_util.tree_map(
+            lambda a: lax.pmean(a, axis), params)
+        return params, auxs
+
+    return round_fn
+
+
+class LocalSGDOptimizer:
+    """Eager wrapper: local inner steps + k-cadence parameter average
+    over the dp group (reference LocalSGDOptimizer's begin_step/
+    communicate cadence)."""
+
+    def __init__(self, inner_optimizer, k_steps: int = 1, group=None):
+        if k_steps < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = inner_optimizer
+        self.k_steps = int(k_steps)
+        self._group = group
+        self._step_i = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def step(self):
+        self._inner.step()
+        self._step_i += 1
+        if self._step_i % self.k_steps == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        from ..collective import ReduceOp, all_reduce
+        for p in self._inner._parameter_list:
+            all_reduce(p, op=ReduceOp.AVG, group=self._group)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._inner._parameter_list]

@@ -1,4 +1,4 @@
 from .optimizer import (Optimizer, SGD, Momentum, Adagrad, Adam, AdamW,
-                        Adamax, RMSProp, Adadelta, Lamb)
+                        Adamax, RMSProp, Adadelta, Lamb, LarsMomentum)
 from .lbfgs import LBFGS
 from . import lr

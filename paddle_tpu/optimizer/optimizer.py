@@ -23,7 +23,7 @@ from ..core.tensor import Tensor, no_grad
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
-           "Adamax", "RMSProp", "Adadelta", "Lamb"]
+           "Adamax", "RMSProp", "Adadelta", "Lamb", "LarsMomentum"]
 
 
 class Optimizer:
@@ -333,6 +333,56 @@ class Adadelta(Optimizer):
         self._set_acc("avg_squared_grad", p, avg_sq_new)
         self._set_acc("avg_squared_update", p, avg_upd_new)
         return p._array.astype(jnp.float32) - lr * upd
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive rate scaling over momentum SGD — the
+    large-batch training optimizer (ResNet at 32k batch).
+
+    Reference: fleet/meta_optimizers/lars_optimizer.py +
+    optimizer.LarsMomentumOptimizer (lars_momentum kernel):
+
+        local_lr = lr * lars_coeff * ||w|| /
+                   (||g|| + lars_weight_decay * ||w|| + epsilon)
+        v        = momentum * v + local_lr * (g + lars_weight_decay * w)
+        w        = w - v
+
+    ``exclude_from_weight_decay``: substrings of parameter names (bias,
+    batch-norm scales) whose trust ratio drops the decay term, matching
+    the reference's name-match exclusion.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, epsilon=0.0,
+                 exclude_from_weight_decay=None, grad_clip=None,
+                 name=None):
+        # lars_weight_decay lives inside the trust ratio; the base
+        # class's additive decay must stay off
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._epsilon = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _update(self, p, g, lr):
+        v = self._acc("velocity", p)
+        w = p._array.astype(jnp.float32)
+        wd = self._lars_wd
+        if self._exclude and any(s in (p.name or "")
+                                 for s in self._exclude):
+            wd = 0.0
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm
+            / (g_norm + wd * w_norm + self._epsilon),
+            lr)
+        v_new = self._momentum * v + local_lr * (g + wd * w)
+        self._set_acc("velocity", p, v_new)
+        return w - v_new
 
 
 class Lamb(Optimizer):

@@ -188,30 +188,39 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     names = args.ops.split(",") if args.ops else None
-    kind, results = measure(names, quick=args.quick)
 
     book = {}
     if os.path.exists(BASELINE):
         with open(BASELINE) as f:
             book = json.load(f)
-    key = f"{kind}{'|quick' if args.quick else ''}"
 
     import platform
     host = platform.node()
 
     if args.record:
-        prev = book.get(key, {})
+        # refuse BEFORE the (potentially minutes-long) measurement:
+        # every input to the check is already known
+        import jax
+        kind0 = getattr(jax.devices()[0], "device_kind", "cpu")
+        key0 = f"{kind0}{'|quick' if args.quick else ''}"
+        prev = book.get(key0, {})
         prev_host = prev.get("__host__")
-        survivors = set(prev) - set(results) - {"__host__"}
+        will_record = set(names or _cases(quick=args.quick))
+        survivors = set(prev) - will_record - {"__host__"}
         if prev_host is not None and prev_host != host and survivors:
             # merging would relabel host-A wall-clocks as host-B's and
             # gate them at the strict same-host threshold
             raise SystemExit(
-                f"refusing partial --record: {key!r} was recorded on "
+                f"refusing partial --record: {key0!r} was recorded on "
                 f"{prev_host!r} and ops {sorted(survivors)} would keep "
                 f"its numbers under this host's ({host!r}) label. "
                 "Re-record ALL ops (drop --ops) or delete the key from "
                 f"{BASELINE} first.")
+
+    kind, results = measure(names, quick=args.quick)
+    key = f"{kind}{'|quick' if args.quick else ''}"
+
+    if args.record:
         book.setdefault(key, {}).update(results)
         book[key]["__host__"] = host
         with open(BASELINE, "w") as f:
