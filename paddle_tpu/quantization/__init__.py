@@ -18,6 +18,8 @@ from .quanters import (  # noqa: F401
 from .config import QuantConfig, SingleLayerConfig  # noqa: F401
 from .qat import (  # noqa: F401
     QAT, PTQ, QuantedWrapper, ObserveWrapper, quant_aware, convert)
+from .quantized_layers import (  # noqa: F401
+    QuantizedLinear, QuantizedConv2D)
 
 __all__ = [
     "fake_quant_dequant", "quant_tensor", "dequant_tensor",
@@ -26,5 +28,5 @@ __all__ = [
     "AbsmaxObserver", "MovingAverageAbsmaxObserver",
     "QuantConfig", "SingleLayerConfig",
     "QAT", "PTQ", "QuantedWrapper", "ObserveWrapper", "quant_aware",
-    "convert",
+    "convert", "QuantizedLinear", "QuantizedConv2D",
 ]

@@ -107,8 +107,8 @@ class QAT:
             model = copy.deepcopy(model)
         return _transform(model, self._config, QuantedWrapper)
 
-    def convert(self, model: Layer, inplace=False) -> Layer:
-        return convert(model, inplace=inplace)
+    def convert(self, model: Layer, inplace=False, to_int8=False) -> Layer:
+        return convert(model, inplace=inplace, to_int8=to_int8)
 
 
 class PTQ:
@@ -129,19 +129,32 @@ class PTQ:
         model.eval()
         return _transform(model, self._config, QuantedWrapper)
 
-    def convert(self, model: Layer, inplace=False) -> Layer:
-        return convert(model, inplace=inplace)
+    def convert(self, model: Layer, inplace=False, to_int8=False) -> Layer:
+        return convert(model, inplace=inplace, to_int8=to_int8)
 
 
-def convert(model: Layer, inplace=False) -> Layer:
-    """Freeze quanters: replace each QuantedWrapper by its inner layer with
-    the weight fake-quantized in place (so the exported StableHLO carries
-    the quantization error) and record scales as buffers for int8 export."""
+def convert(model: Layer, inplace=False, to_int8=False) -> Layer:
+    """Freeze quanters.
+
+    Default: replace each QuantedWrapper by its inner layer with the
+    weight fake-quantized in place (the exported StableHLO carries the
+    quantization error) and record scales as buffers.
+
+    ``to_int8=True`` — the QuantizationFreezePass form: wrappers whose
+    BOTH quanters hold scales (PTQ-calibrated or QAT-trained) become
+    int8 inference layers (quantized_layers.QuantizedLinear /
+    QuantizedConv2D): int8 weights in the artifact, activation
+    quantization at the calibrated scale, int8 matmul compute for
+    Linear. The converted model exports via jit.save and serves on the
+    python Predictor and the C ABI unchanged.
+    """
     from ..core.tensor import Tensor
     if not inplace:
         model = copy.deepcopy(model)
     for name, sub in list(model._sub_layers.items()):
         if isinstance(sub, QuantedWrapper):
+            if to_int8 and _to_int8_layer(model, name, sub):
+                continue
             inner = sub._layer
             wq = sub.weight_quanter
             if wq is not None and hasattr(inner, "weight"):
@@ -166,8 +179,55 @@ def convert(model: Layer, inplace=False) -> Layer:
                     pass
             model._sub_layers[name] = inner
         else:
-            convert(sub, inplace=True)
+            convert(sub, inplace=True, to_int8=to_int8)
     return model
+
+
+def _to_int8_layer(model, name, wrapper) -> bool:
+    """Try the int8 freeze for one wrapper; False -> fall back to the
+    fake-quant bake (missing scales, unsupported layer/axis)."""
+    import numpy as np
+
+    from ..nn.layer.common import Linear
+    from .quantized_layers import QuantizedConv2D, QuantizedLinear
+    try:
+        from ..nn.layer.conv import Conv2D
+    except ImportError:  # pragma: no cover
+        Conv2D = ()
+
+    wq, aq = wrapper.weight_quanter, wrapper.activation_quanter
+    if wq is None or aq is None:
+        return False
+    act_scale = np.asarray(aq.scales()._array)
+    if float(np.max(np.abs(act_scale))) == 0.0:
+        raise ValueError(
+            f"convert(to_int8=True): layer {name!r} has an all-zero "
+            "activation scale — run calibration batches through the "
+            "observed model (PTQ) or train the QAT model first; "
+            "freezing now would saturate every activation to +-127")
+    for q, what in ((aq, "activation"), (wq, "weight")):
+        # fake quanters init their scale to a plausible-looking 1.0;
+        # only the _updated flag distinguishes trained from untouched
+        if getattr(q, "_updated", None) is False:
+            raise ValueError(
+                f"convert(to_int8=True): layer {name!r}'s {what} "
+                "quanter never observed data (scale is its init, not a "
+                "measurement) — train the QAT model before freezing")
+    inner = wrapper._layer
+    try:
+        if isinstance(inner, Linear):
+            model._sub_layers[name] = QuantizedLinear.from_observed(
+                inner, wq, aq)
+            return True
+        if Conv2D and isinstance(inner, Conv2D):
+            model._sub_layers[name] = QuantizedConv2D.from_observed(
+                inner, wq, aq)
+            return True
+    except ValueError as e:
+        import warnings
+        warnings.warn(f"convert(to_int8=True): {name!r} falls back to "
+                      f"fake-quant baking: {e}")
+    return False
 
 
 def quant_aware(model: Layer, config: QuantConfig = None,

@@ -76,6 +76,10 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
         self.register_buffer("_scale", Tensor(jnp.ones([], jnp.float32)))
         self.register_buffer("_state", Tensor(jnp.ones([], jnp.float32)))
         self.register_buffer("_accum", Tensor(jnp.ones([], jnp.float32)))
+        # flips on the first training-mode observation; the int8 freeze
+        # refuses quanters that never saw data (scale would be the
+        # meaningless init of 1.0)
+        self._updated = False
 
     def _absmax(self, arr):
         if self._quant_axis is None:
@@ -85,6 +89,7 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
 
     def forward(self, x):
         if self.training:
+            self._updated = True
             absmax = self._absmax(x._array)
             if self._scale._array.shape != absmax.shape:
                 # first per-channel observation: grow the scalar buffers

@@ -1,0 +1,178 @@
+"""Int8 inference layers — the deploy form of QAT/PTQ-calibrated models.
+
+Reference analog: the QuantizationFreezePass + AddQuantDequantPass
+product (static/quantization/quantization_pass.py:103,1827): ops in the
+served program consume int8-quantized activations against int8 weights,
+with calibrated (PTQ) or trained (QAT) scales baked in.
+
+TPU-native design: instead of IR passes inserting quant/dequant ops
+into a ProgramDesc, ``quantization.convert(model, to_int8=True)``
+replaces each calibrated QuantedWrapper with one of these layers; the
+whole model then exports through the ordinary ``jit.save`` StableHLO
+path and serves on the python Predictor and the C ABI unchanged.
+
+- ``QuantizedLinear`` computes in REAL int8: the activation quantizes
+  at the calibrated scale, the int8 x int8 matmul accumulates in int32
+  (``preferred_element_type`` — the MXU's native int8 path), and one
+  fused rescale dequantizes the result.
+- ``QuantizedConv2D`` stores int8 weights and quant-dequants the
+  activation at its calibrated scale (the AddQuantDequantPass form);
+  the conv itself runs in float after weight dequant — int8 conv
+  lowering is not portable across XLA backends, so the numerics of
+  int8 serving are kept while the op stays compilable everywhere.
+
+Scale convention matches quantization.functional: ``scale`` is the
+observed absmax; q = round(x / scale * qmax), x ~ q * scale / qmax.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantizedLinear", "QuantizedConv2D"]
+
+
+def _qmax(bits: int) -> float:
+    return float(2 ** (bits - 1) - 1)
+
+
+class QuantizedLinear(Layer):
+    """y = dequant(int8(x) @ int8(W)) + b with per-out-channel weight
+    scales (weight layout [in, out], scale shape [out] or scalar)."""
+
+    def __init__(self, qweight, w_scale, act_scale, bias=None, bits=8):
+        super().__init__()
+        self._bits = int(bits)
+        self.register_buffer("qweight", Tensor(jnp.asarray(qweight,
+                                                           jnp.int8)))
+        self.register_buffer("w_scale",
+                             Tensor(jnp.asarray(w_scale, jnp.float32)))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(act_scale, jnp.float32)))
+        if bias is not None:
+            self.register_buffer("bias",
+                                 Tensor(jnp.asarray(bias, jnp.float32)))
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_observed(cls, layer, weight_quanter, act_quanter):
+        """Build from a calibrated/trained QuantedWrapper's pieces: a
+        Linear plus its weight/activation quanters (both must hold
+        scales — PTQ-observed or QAT-trained)."""
+        from .functional import quant_tensor
+
+        w = layer.weight._array
+        ws = jnp.asarray(weight_quanter.scales()._array, jnp.float32)
+        if ws.ndim > 0 and weight_quanter.quant_axis not in (1, None):
+            raise ValueError(
+                "QuantizedLinear needs per-OUT-channel weight scales "
+                f"(quant_axis=1) or per-tensor; got quant_axis="
+                f"{weight_quanter.quant_axis} — the per-in scale does "
+                "not factor out of an int8 contraction")
+        sa = jnp.asarray(act_quanter.scales()._array, jnp.float32)
+        if sa.ndim > 0:
+            raise ValueError(
+                "QuantizedLinear needs a PER-TENSOR activation scale; "
+                f"got shape {tuple(sa.shape)} (per-channel act quant "
+                "does not factor out of the int8 contraction)")
+        q = quant_tensor(w, ws if ws.ndim == 0 else ws[None, :],
+                         bits=weight_quanter.bit_length)
+        bias = getattr(layer, "bias", None)
+        return cls(q, ws, act_quanter.scales()._array,
+                   bias=None if bias is None else bias._array,
+                   bits=weight_quanter.bit_length)
+
+    def forward(self, x):
+        from .functional import quant_tensor
+
+        qmax = _qmax(self._bits)
+        bits = self._bits
+        qw = self.qweight._array
+        ws = self.w_scale._array
+        sa = self.act_scale._array
+        b = None if self.bias is None else self.bias._array
+
+        def f(xa):
+            xq = quant_tensor(xa, sa, bits=bits)
+            y32 = jax.lax.dot_general(
+                xq, qw, (((xa.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            # one fused rescale: (sa/qmax) * (ws/qmax), ws broadcasts
+            # over the output channel
+            y = y32.astype(jnp.float32) * (jnp.maximum(sa, 1e-9) / qmax) \
+                * (jnp.maximum(ws, 1e-9) / qmax)
+            return y if b is None else y + b
+        return apply_op(f, x, op_name="quantized_linear")
+
+    def extra_repr(self):
+        return (f"in={self.qweight.shape[0]}, out={self.qweight.shape[1]}"
+                f", bits={self._bits}, int8_compute=True")
+
+
+class QuantizedConv2D(Layer):
+    """Conv with int8-stored weights + activation quant-dequant at the
+    calibrated scale; see module docstring for why the conv itself runs
+    in float."""
+
+    def __init__(self, conv, qweight, w_scale, act_scale, bits=8):
+        super().__init__()
+        self._conv = conv
+        self._bits = int(bits)
+        self.register_buffer("qweight", Tensor(jnp.asarray(qweight,
+                                                           jnp.int8)))
+        self.register_buffer("w_scale",
+                             Tensor(jnp.asarray(w_scale, jnp.float32)))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(act_scale, jnp.float32)))
+        # the float weight is rebuilt from int8 at forward; drop the
+        # original parameter so the exported artifact carries int8 only
+        self._conv.weight = None
+
+    @classmethod
+    def from_observed(cls, layer, weight_quanter, act_quanter):
+        from .functional import quant_tensor
+
+        sa = jnp.asarray(act_quanter.scales()._array, jnp.float32)
+        if sa.ndim > 0:
+            raise ValueError(
+                "QuantizedConv2D needs a PER-TENSOR activation scale; "
+                f"got shape {tuple(sa.shape)}")
+        w = layer.weight._array
+        ws = jnp.asarray(weight_quanter.scales()._array, jnp.float32)
+        axis = weight_quanter.quant_axis
+        if ws.ndim > 0:
+            shape = [1] * w.ndim
+            shape[0 if axis is None else axis] = -1
+            q = quant_tensor(w, jnp.reshape(ws, shape),
+                             bits=weight_quanter.bit_length)
+        else:
+            q = quant_tensor(w, ws, bits=weight_quanter.bit_length)
+        self_ = cls(layer, q, ws, act_quanter.scales()._array,
+                    bits=weight_quanter.bit_length)
+        self_._w_quant_axis = axis
+        return self_
+
+    def forward(self, x):
+        from .functional import dequant_tensor, fake_quant_dequant
+
+        qmax_bits = self._bits
+        ws = self.w_scale._array
+        if ws.ndim > 0:
+            shape = [1] * self.qweight._array.ndim
+            shape[getattr(self, "_w_quant_axis", 0) or 0] = -1
+            ws = jnp.reshape(ws, shape)
+        w = dequant_tensor(self.qweight._array, ws, bits=qmax_bits)
+        xa = apply_op(
+            lambda a: fake_quant_dequant(a, self.act_scale._array,
+                                         bits=qmax_bits),
+            x, op_name="quant_dequant_act")
+        self._conv.weight = Tensor(w)
+        try:
+            return self._conv(xa)
+        finally:
+            self._conv.weight = None

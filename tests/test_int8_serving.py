@@ -1,0 +1,233 @@
+"""Int8 activation quantization into served artifacts.
+
+Reference analog: static/quantization/quantization_pass.py:103
+(QuantizationTransformPass — quant/dequant at activation edges with
+calibrated scales), :1827 (AddQuantDequantPass) and
+QuantizationFreezePass — the served program computes against int8
+weights and int8-quantized activations, from PTQ-calibrated OR
+QAT-trained scales. Here the freeze is convert(to_int8=True) and the
+serving boundary is the jit.save StableHLO artifact, consumed by the
+python Predictor and the C ABI.
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.quantization import (PTQ, QAT, QuantConfig,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     QuantizedConv2D, QuantizedLinear)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp():
+    paddle.seed(5)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _rel_err(a, b):
+    return float(np.abs(a - b).max() / (np.abs(b).max() + 1e-9))
+
+
+def _calibrated_int8(net, X, n_batches=4, bs=16):
+    ptq = PTQ()
+    observed = ptq.quantize(net)
+    for i in range(n_batches):
+        observed(paddle.to_tensor(X[i * bs:(i + 1) * bs]))
+    q = ptq.convert(observed, to_int8=True)
+    q.eval()
+    return q
+
+
+def test_ptq_int8_linear_predictor_parity(tmp_path):
+    net = _mlp()
+    net.eval()
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 16)).astype(np.float32)
+    ref = net(paddle.to_tensor(X)).numpy()
+
+    q = _calibrated_int8(net, X)
+    assert sum(isinstance(s, QuantizedLinear) for s in q.sublayers()) == 2
+
+    prefix = str(tmp_path / "q")
+    paddle.jit.save(q, prefix, input_spec=[InputSpec([8, 16], "float32")])
+    pred = inference.create_predictor(inference.Config(prefix + ".pdmodel"))
+    got = pred.run([X[:8]])[0]
+    assert _rel_err(got, ref[:8]) < 0.05
+
+    # the artifact carries int8 weights (payload shrinks vs fp32 export)
+    import jax.numpy as jnp
+    from paddle_tpu.framework.io import load as fload
+    payload = fload(prefix + ".pdiparams")
+    int8_keys = [k for k, v in payload.items()
+                 if v._array.dtype == jnp.int8]
+    assert len(int8_keys) == 2, sorted(payload)
+    fp32_prefix = str(tmp_path / "fp32")
+    paddle.jit.save(net, fp32_prefix,
+                    input_spec=[InputSpec([8, 16], "float32")])
+    assert os.path.getsize(prefix + ".pdiparams") < \
+        0.5 * os.path.getsize(fp32_prefix + ".pdiparams")
+
+
+def test_qat_trained_scales_flow_into_artifact(tmp_path):
+    """QAT path: train with fake quant, freeze to int8, export — the
+    artifact's act_scale buffers ARE the QAT-trained moving-average
+    scales, and serving matches the QAT eval forward within int8
+    tolerance."""
+    net = _mlp()
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    qat = QAT(cfg)
+    net.train()
+    qmodel = qat.quantize(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=qmodel.parameters())
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((64, 16)).astype(np.float32)
+    Y = rng.standard_normal((64, 4)).astype(np.float32)
+    for i in range(8):
+        xb = paddle.to_tensor(X[i * 8:(i + 1) * 8])
+        yb = paddle.to_tensor(Y[i * 8:(i + 1) * 8])
+        loss = paddle.mean((qmodel(xb) - yb) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    # the trained activation scale of the FIRST wrapped linear
+    from paddle_tpu.quantization import QuantedWrapper
+    w0 = next(s for s in qmodel.sublayers()
+              if isinstance(s, QuantedWrapper))
+    trained_scale = float(np.asarray(
+        w0.activation_quanter.scales().numpy()))
+    assert trained_scale > 0
+
+    qmodel.eval()
+    frozen = qat.convert(qmodel, to_int8=True)
+    frozen.eval()
+    ql0 = next(s for s in frozen.sublayers()
+               if isinstance(s, QuantizedLinear))
+    np.testing.assert_allclose(
+        float(np.asarray(ql0.act_scale.numpy())), trained_scale,
+        rtol=1e-6)
+
+    prefix = str(tmp_path / "qat8")
+    paddle.jit.save(frozen, prefix,
+                    input_spec=[InputSpec([8, 16], "float32")])
+    pred = inference.create_predictor(
+        inference.Config(prefix + ".pdmodel"))
+    got = pred.run([X[:8]])[0]
+    ref = frozen(paddle.to_tensor(X[:8])).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_int8_activation_edges():
+    paddle.seed(9)
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Conv2D(8, 4, 3, padding=1))
+    net.eval()
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((8, 3, 10, 10)).astype(np.float32)
+    ref = net(paddle.to_tensor(X)).numpy()
+    q = _calibrated_int8(net, X, n_batches=2, bs=4)
+    assert sum(isinstance(s, QuantizedConv2D) for s in q.sublayers()) == 2
+    out = q(paddle.to_tensor(X)).numpy()
+    assert _rel_err(out, ref) < 0.1
+    assert np.abs(out - ref).max() > 0  # real quantization error baked
+
+
+def test_uncalibrated_freeze_raises():
+    net = _mlp()
+    ptq = PTQ()
+    observed = ptq.quantize(net)  # NO calibration batches
+    with pytest.raises(ValueError, match="calibration"):
+        ptq.convert(observed, to_int8=True)
+
+
+def test_untrained_qat_freeze_raises():
+    """QAT fake quanters init scale to 1.0 (not 0), so the zero guard
+    can't see them — the _updated flag must catch the freeze of a
+    never-trained QAT model instead of silently serving garbage."""
+    net = _mlp()
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    net.train()
+    qmodel = QAT(cfg).quantize(net)  # zero training steps
+    with pytest.raises(ValueError, match="never observed"):
+        QAT(cfg).convert(qmodel, to_int8=True)
+
+
+def test_per_channel_act_scale_falls_back():
+    """A per-channel ACTIVATION observer cannot freeze to int8 compute
+    (the scale doesn't factor out of the contraction); the freeze must
+    fall back to fake-quant baking with a warning — never produce a
+    model that crashes or mis-broadcasts on first forward."""
+    from paddle_tpu.quantization import AbsmaxObserver, QuanterFactory
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1))
+    net.eval()
+    cfg = QuantConfig(
+        activation=QuanterFactory(AbsmaxObserver, quant_axis=1),
+        weight=QuanterFactory(AbsmaxObserver))
+    ptq = PTQ(cfg)
+    observed = ptq.quantize(net)
+    rng = np.random.default_rng(4)
+    X = rng.standard_normal((4, 3, 8, 8)).astype(np.float32)
+    observed(paddle.to_tensor(X))
+    with pytest.warns(UserWarning, match="falls back"):
+        frozen = ptq.convert(observed, to_int8=True)
+    frozen.eval()
+    out = frozen(paddle.to_tensor(X))  # must run, not crash
+    assert np.isfinite(out.numpy()).all()
+    assert not any(isinstance(s, (QuantizedConv2D, QuantizedLinear))
+                   for s in frozen.sublayers())
+
+
+@pytest.mark.slow
+def test_c_abi_serves_int8_artifact(tmp_path):
+    """The C host (libpaddle_tpu_capi.so) serves the int8 artifact
+    within tolerance of the fp32 reference — the reference's
+    'quantized program runs on the C++ predictor' contract."""
+    import test_capi_predictor as tcp
+
+    if not os.path.exists(tcp.CAPI_SO):
+        subprocess.run(["make", "-C", tcp.CSRC, "capi"], check=True)
+    host_src = tmp_path / "host.c"
+    host_src.write_text(tcp.HOST_C)
+    host_bin = str(tmp_path / "host")
+    subprocess.run(
+        ["gcc", str(host_src), "-o", host_bin, f"-I{tcp.CSRC}",
+         f"-L{tcp.CSRC}", "-lpaddle_tpu_capi", f"-Wl,-rpath,{tcp.CSRC}"],
+        check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_CAPI_PLATFORM"] = "cpu"
+
+    # the C host feeds a fixed (1, 8) input tensor — size the net to it
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((64, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(X)).numpy()
+    q = _calibrated_int8(net, X)
+    prefix = str(tmp_path / "q8")
+    paddle.jit.save(q, prefix, input_spec=[InputSpec([1, 8], "float32")])
+
+    x = X[:1]
+    x_file = tmp_path / "input.bin"
+    x_file.write_bytes(x.tobytes())
+    proc = subprocess.run([host_bin, prefix, str(x_file)],
+                          capture_output=True, text=True, env=env,
+                          timeout=240)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    got = np.array([float(v) for v in proc.stdout.split()],
+                   dtype=np.float32).reshape(1, 4)
+    assert _rel_err(got, ref[:1]) < 0.05
