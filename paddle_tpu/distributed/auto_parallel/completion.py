@@ -83,12 +83,15 @@ class _Propagator:
         self.mesh = dict(mesh_dims)
         self.ctx = ctx
         self.report = PropagationReport()
+        self._mute = 0  # >0 during fixpoint probing runs (no recording)
 
     # -- helpers ------------------------------------------------------------
     def _axis_n(self, axis) -> int:
         return int(self.mesh.get(axis, 1))
 
     def _record(self, prim, kind, axis, nbytes):
+        if self._mute:
+            return
         n = self._axis_n(axis)
         if n <= 1 or nbytes == 0:
             return
@@ -220,6 +223,97 @@ class _Propagator:
         perm = params["permutation"]
         return [tuple(in_specs[0][p] for p in perm)]
 
+    def _rule_concatenate(self, prim, params, in_specs, in_avals,
+                          out_avals):
+        """Concat along an unsharded dim keeps the operands' merged
+        shardings (RoPE's rotate_half); an operand sharded along the
+        concat dim itself reshards."""
+        d_cat = int(params["dimension"])
+        out_ndim = len(out_avals[0].shape)
+        merged: List[Optional[str]] = [None] * out_ndim
+        for d in range(out_ndim):
+            if d == d_cat:
+                for s, a in zip(in_specs, in_avals):
+                    if s[d] is not None:
+                        self._record(prim, "all_gather", s[d],
+                                     self._local_bytes(a, s))
+                continue
+            axes = {s[d] for s in in_specs if s[d] is not None}
+            if len(axes) == 1:
+                merged[d] = axes.pop()
+            elif len(axes) > 1:
+                vol: Dict[str, int] = {}
+                for s, a in zip(in_specs, in_avals):
+                    if s[d] is not None:
+                        vol[s[d]] = vol.get(s[d], 0) \
+                            + self._local_bytes(a, s)
+                keep = max(sorted(vol), key=lambda ax: vol[ax])
+                merged[d] = keep
+                for s, a in zip(in_specs, in_avals):
+                    if s[d] is not None and s[d] != keep:
+                        self._record(prim, "all_gather", s[d],
+                                     self._local_bytes(a, s))
+        return [tuple(merged)]
+
+    def _rule_slice(self, prim, params, in_specs, in_avals, out_avals):
+        """Slicing an UNSHARDED dim keeps every sharding (RoPE's
+        half-head-dim split, qkv splits); slicing into a sharded dim
+        would need halo/gather — reshard that axis."""
+        spec, a, o = in_specs[0], in_avals[0], out_avals[0]
+        out: List[Optional[str]] = [None] * len(o.shape)
+        for d in range(len(a.shape)):
+            if spec[d] is None:
+                continue
+            if a.shape[d] == o.shape[d]:
+                out[d] = spec[d]  # full extent: sharding survives
+            else:
+                self._record(prim, "all_gather", spec[d],
+                             self._local_bytes(a, spec))
+        return [tuple(out)]
+
+    def _rule_gather(self, prim, params, in_specs, in_avals, out_avals):
+        """Embedding-style and batch-aligned gathers propagate without
+        collectives under GSPMD:
+
+        - fully replicated operand (embed[ids]): the output's batch
+          dims take the indices' shardings, offset dims replicate;
+        - operand sharded ONLY on batching dims whose paired indices
+          dim carries the same axis (take_along_axis on a dp batch):
+          same propagation, shard included.
+
+        Anything else (operand sharded on a gathered dim) falls back to
+        the conservative gather-to-replicated."""
+        dn = params["dimension_numbers"]
+        op_spec, idx_spec = in_specs[0], in_specs[1]
+        op_a, idx_a = in_avals[0], in_avals[1]
+        obd = tuple(getattr(dn, "operand_batching_dims", ()) or ())
+        sbd = tuple(getattr(dn, "start_indices_batching_dims", ()) or ())
+        aligned = True
+        for d, ax in enumerate(op_spec):
+            if ax is None:
+                continue
+            if d in obd and idx_spec[sbd[obd.index(d)]] == ax:
+                continue
+            aligned = False
+            break
+        if not aligned:
+            for s, a in zip(in_specs, in_avals):
+                if any(x is not None for x in s):
+                    self._gather_to_replicated(prim, s, a)
+            return [(None,) * len(o.shape) for o in out_avals]
+        # output layout: non-offset dims mirror the indices' batch dims
+        # (all indices dims except the trailing index-vector dim), in
+        # order; offset dims are slice extents (replicated)
+        offset = set(dn.offset_dims)
+        idx_batch = [idx_spec[d] for d in range(len(idx_a.shape) - 1)]
+        o = out_avals[0]
+        out_spec: List[Optional[str]] = [None] * len(o.shape)
+        it = iter(idx_batch)
+        for d in range(len(o.shape)):
+            if d not in offset:
+                out_spec[d] = next(it, None)
+        return [tuple(out_spec)]
+
     def _rule_reshape(self, prim, params, in_specs, in_avals, out_avals):
         """Factor the reshape into groups of input/output dims with
         equal products (the GSPMD propagation view of reshape):
@@ -285,6 +379,105 @@ class _Propagator:
         self._record_gathers(prim, a, spec, lost)
         return [tuple(out)]
 
+    # -- control flow -------------------------------------------------------
+    def _rule_scan(self, params, in_specs, in_avals, out_avals):
+        """lax.scan: propagate through the body at a FIXPOINT of the
+        carry specs (probing runs muted), then one recording run whose
+        per-iteration collectives get their time scaled by ``length``.
+        A carry whose body output is sharded where the loop-invariant
+        spec is not forces a back-edge reshard every iteration — the
+        cost XLA pays as an all-gather inside the while body."""
+        body = getattr(params["jaxpr"], "jaxpr", params["jaxpr"])
+        nc = int(params.get("num_consts", 0))
+        nk = int(params.get("num_carry", 0))
+        length = int(params.get("length", 1))
+        consts = list(in_specs[:nc])
+        carry = [tuple(s) for s in in_specs[nc:nc + nk]]
+        xs = []
+        for s, a in zip(in_specs[nc + nk:], in_avals[nc + nk:]):
+            if s[0] is not None:
+                # xs sharded along the SCAN dim (pipeline-style layer
+                # placement): every iteration fetches its slice from
+                # the owning shard — one per-iteration collective of
+                # the slice payload, `length` iterations
+                slice_local = self._local_bytes(a, s) \
+                    // max(1, int(a.shape[0]) // self._axis_n(s[0]))
+                r0 = len(self.report.reshards)
+                self._record("scan_xs", "all_gather", s[0], slice_local)
+                for r in self.report.reshards[r0:]:
+                    r.cost_us *= length
+            xs.append(tuple(s[1:]))
+
+        self._mute += 1
+        try:
+            for _ in range(4):
+                out = self.run_sub(body, consts + carry + xs)
+                merged = [tuple(a if a == b else None
+                                for a, b in zip(c, o))
+                          for c, o in zip(carry, out[:nk])]
+                if merged == carry:
+                    break
+                carry = merged
+        finally:
+            self._mute -= 1
+
+        n0 = len(self.report.reshards)
+        out = self.run_sub(body, consts + carry + xs)
+        for r in self.report.reshards[n0:]:
+            r.cost_us *= length
+        # back-edge reshards: body output sharded where the stable
+        # carry spec is replicated
+        for i in range(nk):
+            for ax_o, ax_c in zip(out[i], carry[i]):
+                if ax_o is not None and ax_c is None:
+                    r0 = len(self.report.reshards)
+                    self._record("scan_carry", "all_gather", ax_o,
+                                 self._local_bytes(out_avals[i], out[i]))
+                    for r in self.report.reshards[r0:]:
+                        r.cost_us *= length
+        ys = [(None,) + tuple(s) for s in out[nk:]]
+        return [tuple(c) for c in carry] + ys
+
+    def _rule_while(self, params, in_specs, in_avals, out_avals):
+        """lax.while_loop: like scan's fixpoint but with unknown trip
+        count — per-iteration collective costs stay un-scaled (a lower
+        bound), specs still converge."""
+        body = getattr(params["body_jaxpr"], "jaxpr",
+                       params["body_jaxpr"])
+        nb = int(params.get("body_nconsts", 0))
+        nc_cond = int(params.get("cond_nconsts", 0))
+        consts = list(in_specs[nc_cond:nc_cond + nb])
+        carry = [tuple(s) for s in in_specs[nc_cond + nb:]]
+        self._mute += 1
+        try:
+            for _ in range(4):
+                out = self.run_sub(body, consts + carry)
+                merged = [tuple(a if a == b else None
+                                for a, b in zip(c, o))
+                          for c, o in zip(carry, out)]
+                if merged == carry:
+                    break
+                carry = merged
+        finally:
+            self._mute -= 1
+        self.run_sub(body, consts + carry)
+        return [tuple(c) for c in carry]
+
+    def _rule_cond(self, params, in_specs, in_avals, out_avals):
+        """lax.cond/switch: every branch is materialized in the HLO, so
+        each branch's reshards record; outputs take the branch meet."""
+        branches = params["branches"]
+        operands = list(in_specs[1:])  # invars[0] is the branch index
+        outs = []
+        for br in branches:
+            outs.append(self.run_sub(getattr(br, "jaxpr", br), operands))
+        merged = []
+        for parts in zip(*outs):
+            merged.append(tuple(
+                a if all(a == p[i] for p in parts) else None
+                for i, a in enumerate(parts[0])))
+        return merged
+
     # -- driver -------------------------------------------------------------
     def run(self, jaxpr, in_specs: Sequence[Spec]):
         env: Dict[Any, Spec] = {}
@@ -325,6 +518,13 @@ class _Propagator:
         return self.run(jaxpr, in_specs)
 
     def _dispatch(self, prim, params, in_specs, in_avals, out_avals):
+        if prim == "scan":
+            return self._rule_scan(params, in_specs, in_avals, out_avals)
+        if prim == "while":
+            return self._rule_while(params, in_specs, in_avals,
+                                    out_avals)
+        if prim == "cond":
+            return self._rule_cond(params, in_specs, in_avals, out_avals)
         if prim == "dot_general":
             return self._rule_dot_general(prim, params, in_specs,
                                           in_avals, out_avals)
@@ -334,6 +534,15 @@ class _Propagator:
         if prim == "transpose":
             return self._rule_transpose(prim, params, in_specs, in_avals,
                                         out_avals)
+        if prim == "slice":
+            return self._rule_slice(prim, params, in_specs, in_avals,
+                                    out_avals)
+        if prim == "concatenate":
+            return self._rule_concatenate(prim, params, in_specs,
+                                          in_avals, out_avals)
+        if prim == "gather":
+            return self._rule_gather(prim, params, in_specs, in_avals,
+                                     out_avals)
         if prim == "reshape":
             return self._rule_reshape(prim, params, in_specs, in_avals,
                                       out_avals)

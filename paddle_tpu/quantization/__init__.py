@@ -14,7 +14,7 @@ from .functional import (  # noqa: F401
 from .quanters import (  # noqa: F401
     BaseQuanter, quanter, QuanterFactory, FakeQuanterWithAbsMaxObserver,
     FakeQuanterWithAbsMaxObserverLayer, AbsmaxObserver,
-    MovingAverageAbsmaxObserver)
+    MovingAverageAbsmaxObserver, KLObserver)
 from .config import QuantConfig, SingleLayerConfig  # noqa: F401
 from .qat import (  # noqa: F401
     QAT, PTQ, QuantedWrapper, ObserveWrapper, quant_aware, convert)
@@ -25,7 +25,7 @@ __all__ = [
     "fake_quant_dequant", "quant_tensor", "dequant_tensor",
     "BaseQuanter", "quanter", "QuanterFactory",
     "FakeQuanterWithAbsMaxObserver", "FakeQuanterWithAbsMaxObserverLayer",
-    "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "AbsmaxObserver", "MovingAverageAbsmaxObserver", "KLObserver",
     "QuantConfig", "SingleLayerConfig",
     "QAT", "PTQ", "QuantedWrapper", "ObserveWrapper", "quant_aware",
     "convert", "QuantizedLinear", "QuantizedConv2D",

@@ -16,7 +16,7 @@ from .functional import fake_quant_dequant
 __all__ = ["BaseQuanter", "quanter", "QuanterFactory",
            "FakeQuanterWithAbsMaxObserver",
            "FakeQuanterWithAbsMaxObserverLayer",
-           "AbsmaxObserver", "MovingAverageAbsmaxObserver"]
+           "AbsmaxObserver", "MovingAverageAbsmaxObserver", "KLObserver"]
 
 
 class BaseQuanter(Layer):
@@ -78,8 +78,15 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
         self.register_buffer("_accum", Tensor(jnp.ones([], jnp.float32)))
         # flips on the first training-mode observation; the int8 freeze
         # refuses quanters that never saw data (scale would be the
-        # meaningless init of 1.0)
-        self._updated = False
+        # meaningless init of 1.0). A BUFFER so it survives the
+        # state_dict roundtrip — a QAT model restored from checkpoint
+        # must still be freezable.
+        self.register_buffer("_seen_data",
+                             Tensor(jnp.zeros([], jnp.float32)))
+
+    @property
+    def _updated(self) -> bool:
+        return bool(float(np.asarray(self._seen_data._array)) > 0)
 
     def _absmax(self, arr):
         if self._quant_axis is None:
@@ -89,7 +96,7 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
 
     def forward(self, x):
         if self.training:
-            self._updated = True
+            self._seen_data._array = jnp.ones([], jnp.float32)
             absmax = self._absmax(x._array)
             if self._scale._array.shape != absmax.shape:
                 # first per-channel observation: grow the scalar buffers
@@ -139,6 +146,123 @@ class AbsmaxObserver(BaseQuanter):
         return x
 
     def scales(self):
+        return Tensor(self._scale._array)
+
+
+class KLObserver(BaseQuanter):
+    """PTQ collector choosing the clip threshold by KL divergence
+    (reference: imperative/ptq_quantizer.py KLQuantizer; the TensorRT
+    entropy-calibration algorithm).
+
+    Absmax calibration lets one outlier blow up the scale and waste the
+    int8 range on values that never occur; KL picks the threshold T
+    whose clipped-and-quantized distribution stays closest (min KL) to
+    the observed one. Keeps a bounded reservoir sample of |x| across
+    calibration batches; ``scales()`` runs an iterative range-shrinking
+    entropy search once and caches the result.
+    """
+
+    _RESERVOIR = 200_000
+
+    def __init__(self, bit_length=8, bins=2048):
+        super().__init__()
+        self._bits = bit_length
+        self._bins = int(bins)
+        self._samples = np.zeros(0, np.float32)  # reservoir of |x|
+        self._seen = 0
+        self._rng = np.random.default_rng(0)
+        self.register_buffer("_scale", Tensor(jnp.zeros([], jnp.float32)))
+        self._dirty = False
+
+    def forward(self, x):
+        a = np.abs(np.asarray(x._array, np.float32)).reshape(-1)
+        self._seen += a.size
+        # bounded reservoir: a single coarse histogram loses the bulk's
+        # resolution when one outlier stretches the range; raw samples
+        # let scales() iterate the range down (uniform via subsampling)
+        if self._samples.size + a.size <= self._RESERVOIR:
+            self._samples = np.concatenate([self._samples, a])
+        else:
+            keep = self._RESERVOIR - self._samples.size
+            if keep > 0:
+                self._samples = np.concatenate(
+                    [self._samples,
+                     self._rng.choice(a, size=keep, replace=False)])
+            else:
+                # replace a fraction proportional to the new batch
+                n_rep = max(1, int(self._RESERVOIR * a.size
+                                   / max(self._seen, 1)))
+                n_rep = min(n_rep, a.size, self._RESERVOIR)
+                idx = self._rng.choice(self._RESERVOIR, size=n_rep,
+                                       replace=False)
+                self._samples[idx] = self._rng.choice(
+                    a, size=n_rep, replace=False)
+        self._dirty = True
+        return x
+
+    def _kl_search(self, hist: np.ndarray, bin_w: float,
+                   bins: int) -> float:
+        """One entropy-calibration pass: for candidate bin counts i,
+        clip the tail into bin i-1, quantize the head into 2^(bits-1)
+        levels, keep the i minimizing KL(P || Q)."""
+        n_levels = 2 ** (self._bits - 1)  # 128 magnitude levels
+        best_i, best_kl = bins, np.inf
+        for i in range(n_levels, bins + 1, 8):
+            p = hist[:i].astype(np.float64).copy()
+            p[i - 1] += hist[i:].sum()
+            psum = p.sum()
+            if psum == 0:
+                continue
+            p /= psum
+            q = np.zeros(i, np.float64)
+            for c in np.array_split(np.arange(i), n_levels):
+                seg = hist[c]
+                nz = seg > 0
+                if nz.any():
+                    q[c[nz]] = seg.sum() / nz.sum()
+            qsum = q.sum()
+            if qsum == 0:
+                continue
+            q /= qsum
+            mask = p > 0
+            kl = float(np.sum(p[mask] * np.log(
+                p[mask] / np.maximum(q[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        return best_i * bin_w
+
+    def _kl_threshold(self) -> float:
+        s = self._samples
+        if s.size == 0:
+            return 0.0
+        rng_hi = float(s.max())
+        if rng_hi == 0.0:
+            return 0.0
+        # with few samples the sparse histogram makes KL noise-dominated
+        # and over-aggressive; (a) size the bins to the sample count,
+        # (b) never clip more than 0.01% of the observed mass (the
+        # HistQuantizer-style percentile floor)
+        bins = int(min(self._bins, max(256, s.size // 4)))
+        floor = float(np.quantile(s, 1.0 - 1e-4))
+        # iterate: each round histograms the CLIPPED samples over the
+        # previous threshold, recovering bulk resolution an outlier-
+        # stretched first range destroyed
+        for _ in range(4):
+            hist, _ = np.histogram(np.minimum(s, rng_hi),
+                                   bins=bins, range=(0.0, rng_hi))
+            t = self._kl_search(hist.astype(np.float64),
+                                rng_hi / bins, bins)
+            t = max(t, floor)
+            if t >= rng_hi * 0.95:
+                break
+            rng_hi = t
+        return max(rng_hi, floor)
+
+    def scales(self):
+        if self._dirty:
+            self._scale._array = jnp.asarray(self._kl_threshold(),
+                                             jnp.float32)
+            self._dirty = False
         return Tensor(self._scale._array)
 
 

@@ -144,6 +144,140 @@ def test_reshape_split_keeps_sharding_no_collective(mesh):
     assert not res["predicted"]["counts"], res["report"].reshards
 
 
+def test_scanned_megatron_layers_match_hlo(mesh):
+    """lax.scan over stacked Megatron layer pairs (the flagship llama's
+    layer-stacking pattern): the body's one psum appears ONCE in the
+    while-body HLO and once in the prediction, with per-device payload
+    agreement; the carry spec is loop-invariant so no back-edge
+    reshard."""
+    from jax import lax
+
+    L, B, H, F = 3, 8, 16, 32
+
+    def f(x, w1s, w2s):
+        def body(h, ws):
+            w1, w2 = ws
+            return jnp.maximum(h @ w1, 0.0) @ w2, ()
+        h, _ = lax.scan(body, x, (w1s, w2s))
+        return h
+
+    x = jnp.zeros((B, H), jnp.float32)
+    w1s = jnp.zeros((L, H, F), jnp.float32)
+    w2s = jnp.zeros((L, F, H), jnp.float32)
+    res = validate_propagation(
+        f, (x, w1s, w2s),
+        [("dp", None), (None, None, "mp"), (None, "mp", None)], mesh)
+    _check(res)
+    assert res["predicted"]["counts"].get("all_reduce") == 1, \
+        res["report"].reshards
+    assert res["predicted"]["bytes"]["all_reduce"] == B // 2 * H * 4
+    # the per-iteration psum costs length x one iteration's time
+    ar = next(r for r in res["report"].reshards
+              if r.kind == "all_reduce")
+    from paddle_tpu.distributed.auto_parallel.cost_model import (
+        all_reduce_cost)
+    single = all_reduce_cost(ar.nbytes, 4, axis="mp")
+    assert abs(ar.cost_us - L * single) < 1e-6
+
+
+def test_scan_backedge_reshard_detected(mesh):
+    """A body whose output sharding disagrees with the loop-invariant
+    carry spec forces a reshard on the back edge every iteration —
+    both the predictor and XLA must see a collective."""
+    from jax import lax
+
+    L, B, H = 3, 8, 16
+
+    def f(x, ws):
+        def body(h, w):
+            return jnp.maximum(h @ w, 0.0), ()
+        h, _ = lax.scan(body, x, ws)
+        return h
+
+    x = jnp.zeros((B, H), jnp.float32)
+    ws = jnp.zeros((L, H, H), jnp.float32)
+    res = validate_propagation(
+        f, (x, ws), [("dp", None), (None, None, "mp")], mesh)
+    assert res["predicted"]["counts"], \
+        "predictor missed the back-edge reshard entirely"
+    assert res["actual"]["counts"], res["hlo"]
+
+
+def test_real_llama_tp_step_matches_hlo(mesh):
+    """Capstone: the FULL llama forward+loss (models/llama.py — RoPE
+    slices/concat, scanned layer stack, embedding gather, softmax-CE
+    with take_along) under Megatron TP + dp batch sharding. The
+    predictor must agree with GSPMD exactly: two mp psums per forward
+    (attention out-proj + MLP down-proj, recorded once in the scan
+    body like the HLO while-body) and the dp scalar-loss psum — and
+    NOTHING else (no phantom reshard from slice/concat/gather)."""
+    from paddle_tpu.models.llama import LlamaConfig, init_params, loss_fn
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        dtype=jnp.float32, use_remat=False)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = {"input_ids": np.zeros((4, 32), np.int32),
+             "labels": np.zeros((4, 32), np.int32)}
+
+    def step(params, batch):
+        return loss_fn(cfg, params, batch)[1]
+
+    col = {"wq", "wk", "wv", "w_gate", "w_up"}
+    row = {"wo", "w_down"}
+    lsp = {}
+    for k, a in params["layers"].items():
+        sp = [None] * a.ndim
+        if k in col:
+            sp[-1] = "mp"
+        elif k in row:
+            sp[-2] = "mp"
+        lsp[k] = tuple(sp)
+    specs = {"embed": None, "layers": lsp, "norm_f": None,
+             "lm_head": None}
+    res = validate_propagation(
+        step, (params, batch),
+        [specs, {"input_ids": ("dp", None), "labels": ("dp", None)}],
+        mesh)
+    _check(res)
+    assert res["predicted"]["counts"] == {"all_reduce": 3}, \
+        res["report"].reshards
+    assert res["predicted"]["bytes"] == res["actual"]["bytes"]
+    assert sorted(res["actual"]["axes"]["all_reduce"]) == ["dp", "mp"]
+
+
+def test_scan_xs_sharded_on_scan_dim_not_silent(mesh):
+    """xs sharded along the SCAN dim (pipeline-style layer placement):
+    each iteration fetches its slice from the owning shard. The
+    predictor must report per-iteration traffic, not silently drop the
+    spec and claim zero reshards."""
+    from jax import lax
+
+    from paddle_tpu.distributed.auto_parallel.completion import (
+        propagate_sharding)
+
+    L, B, H = 4, 8, 16
+    x = np.zeros((B, H), np.float32)
+    ws = np.zeros((L, H, H), np.float32)
+
+    def f(x, ws):
+        def body(h, w):
+            return jnp.maximum(h @ w, 0.0), ()
+        h, _ = lax.scan(body, x, ws)
+        return h
+
+    rep = propagate_sharding(f, (x, ws), [None, ("mp", None, None)],
+                             mesh_dims={"mp": 4})
+    xs_reshards = [r for r in rep.reshards if r.prim == "scan_xs"]
+    assert len(xs_reshards) == 1, rep.reshards
+    assert xs_reshards[0].axis == "mp"
+    # per-iteration payload: one full (H, H) layer slice (each of the
+    # mp=4 devices owns exactly one of the L=4 layers)
+    assert xs_reshards[0].nbytes == H * H * 4
+
+
 def test_fold_rs_ag_semantics():
     """The reduce-scatter+all-gather fold must (a) rescale the RS shard
     bytes back to the full all-reduce buffer, (b) consume only the ONE

@@ -126,7 +126,7 @@ def test_qat_trained_scales_flow_into_artifact(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
-def test_conv2d_int8_activation_edges():
+def test_conv2d_int8_activation_edges(tmp_path):
     paddle.seed(9)
     net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
                         nn.Conv2D(8, 4, 3, padding=1))
@@ -140,6 +140,22 @@ def test_conv2d_int8_activation_edges():
     assert _rel_err(out, ref) < 0.1
     assert np.abs(out - ref).max() > 0  # real quantization error baked
 
+    # the docstring's claim is export + serving, not just eager: the
+    # stateful weight-swap in QuantizedConv2D.forward must trace
+    # cleanly through jit.save and serve identically
+    prefix = str(tmp_path / "conv8")
+    paddle.jit.save(q, prefix,
+                    input_spec=[InputSpec([4, 3, 10, 10], "float32")])
+    pred = inference.create_predictor(
+        inference.Config(prefix + ".pdmodel"))
+    got = pred.run([X[:4]])[0]
+    np.testing.assert_allclose(got, out[:4], rtol=1e-5, atol=1e-6)
+    # int8 conv weights actually land in the artifact
+    import jax.numpy as jnp
+    from paddle_tpu.framework.io import load as fload
+    payload = fload(prefix + ".pdiparams")
+    assert sum(v._array.dtype == jnp.int8 for v in payload.values()) == 2
+
 
 def test_uncalibrated_freeze_raises():
     net = _mlp()
@@ -147,6 +163,33 @@ def test_uncalibrated_freeze_raises():
     observed = ptq.quantize(net)  # NO calibration batches
     with pytest.raises(ValueError, match="calibration"):
         ptq.convert(observed, to_int8=True)
+
+
+def test_qat_checkpoint_roundtrip_still_freezes():
+    """The standard train/checkpoint/deploy flow: scales AND the
+    seen-data flag ride the state_dict, so a QAT model restored in a
+    fresh process freezes to int8 (the flag is a buffer, not a plain
+    attribute that a restore would silently reset to False)."""
+    net = _mlp()
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    net.train()
+    qmodel = QAT(cfg).quantize(net)
+    rng = np.random.default_rng(2)
+    for i in range(3):
+        qmodel(paddle.to_tensor(
+            rng.standard_normal((8, 16)).astype(np.float32)))
+    sd = qmodel.state_dict()
+
+    # "new process": rebuild the quantized model, restore
+    net2 = _mlp()
+    net2.train()
+    qmodel2 = QAT(cfg).quantize(net2)
+    qmodel2.set_state_dict(sd)
+    qmodel2.eval()
+    frozen = QAT(cfg).convert(qmodel2, to_int8=True)
+    assert sum(isinstance(s, QuantizedLinear)
+               for s in frozen.sublayers()) == 2
 
 
 def test_untrained_qat_freeze_raises():
@@ -187,6 +230,60 @@ def test_per_channel_act_scale_falls_back():
     assert np.isfinite(out.numpy()).all()
     assert not any(isinstance(s, (QuantizedConv2D, QuantizedLinear))
                    for s in frozen.sublayers())
+
+
+def test_kl_observer_resists_outliers():
+    """KL entropy calibration (KLQuantizer analog): one giant outlier
+    must NOT blow up the scale the way absmax's does — and the int8
+    quantization error on the bulk of the data must be smaller."""
+    from paddle_tpu.quantization import AbsmaxObserver, KLObserver
+    from paddle_tpu.quantization.functional import (dequant_tensor,
+                                                    quant_tensor)
+
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal(20000).astype(np.float32)
+    data[-1] = 1000.0  # one giant outlier
+    bulk = data[:-1]
+
+    kl, am = KLObserver(), AbsmaxObserver()
+    for obs in (kl, am):
+        obs(paddle.to_tensor(data.reshape(4, -1)))
+    s_kl = float(np.asarray(kl.scales().numpy()))
+    s_am = float(np.asarray(am.scales().numpy()))
+    assert s_am >= 999.0
+    assert s_kl < 50.0, s_kl  # clipped the outlier tail
+
+    def int8_err(scale):
+        q = np.asarray(quant_tensor(bulk, scale))
+        return float(np.abs(np.asarray(dequant_tensor(q, scale))
+                            - bulk).mean())
+    assert int8_err(s_kl) < int8_err(s_am) / 10
+
+
+def test_ptq_with_kl_observer_freezes_and_serves(tmp_path):
+    from paddle_tpu.quantization import (AbsmaxObserver, KLObserver,
+                                         QuanterFactory)
+
+    net = _mlp()
+    net.eval()
+    rng = np.random.default_rng(6)
+    X = rng.standard_normal((64, 16)).astype(np.float32)
+    ref = net(paddle.to_tensor(X)).numpy()
+    cfg = QuantConfig(activation=QuanterFactory(KLObserver),
+                      weight=QuanterFactory(AbsmaxObserver))
+    ptq = PTQ(cfg)
+    observed = ptq.quantize(net)
+    for i in range(4):
+        observed(paddle.to_tensor(X[i * 16:(i + 1) * 16]))
+    q = ptq.convert(observed, to_int8=True)
+    q.eval()
+    assert sum(isinstance(s, QuantizedLinear) for s in q.sublayers()) == 2
+    prefix = str(tmp_path / "kl8")
+    paddle.jit.save(q, prefix, input_spec=[InputSpec([8, 16], "float32")])
+    pred = inference.create_predictor(
+        inference.Config(prefix + ".pdmodel"))
+    got = pred.run([X[:8]])[0]
+    assert _rel_err(got, ref[:8]) < 0.05
 
 
 @pytest.mark.slow
