@@ -257,8 +257,10 @@ class _Propagator:
 
     def _rule_slice(self, prim, params, in_specs, in_avals, out_avals):
         """Slicing an UNSHARDED dim keeps every sharding (RoPE's
-        half-head-dim split, qkv splits); slicing into a sharded dim
-        would need halo/gather — reshard that axis."""
+        half-head-dim split, qkv splits, KV-cache dynamic_slice);
+        slicing into a sharded dim would need halo/gather — reshard
+        that axis. Covers slice / dynamic_slice (operand spec first,
+        index operands are scalars)."""
         spec, a, o = in_specs[0], in_avals[0], out_avals[0]
         out: List[Optional[str]] = [None] * len(o.shape)
         for d in range(len(a.shape)):
@@ -266,6 +268,38 @@ class _Propagator:
                 continue
             if a.shape[d] == o.shape[d]:
                 out[d] = spec[d]  # full extent: sharding survives
+            else:
+                self._record(prim, "all_gather", spec[d],
+                             self._local_bytes(a, spec))
+        return [tuple(out)]
+
+    def _rule_dus(self, prim, params, in_specs, in_avals, out_avals):
+        """dynamic_update_slice (KV-cache writes): the operand's spec
+        survives on dims the update spans fully or that are unsharded;
+        updating into a sharded dim reshards the update."""
+        spec, upd_spec = in_specs[0], in_specs[1]
+        a, u = in_avals[0], in_avals[1]
+        out: List[Optional[str]] = list(spec)
+        for d in range(len(a.shape)):
+            if spec[d] is not None and a.shape[d] != u.shape[d]:
+                # partial write into a sharded dim: the update must
+                # reach the owning shard
+                self._record(prim, "all_gather", spec[d],
+                             self._local_bytes(u, upd_spec))
+        return [tuple(out)]
+
+    def _rule_pad(self, prim, params, in_specs, in_avals, out_avals):
+        """Padding an unsharded dim keeps shardings; padding a sharded
+        dim changes its extent non-uniformly across shards — reshard."""
+        cfg = params.get("padding_config", ())
+        spec, a = in_specs[0], in_avals[0]
+        out: List[Optional[str]] = [None] * len(out_avals[0].shape)
+        for d in range(len(a.shape)):
+            lo, hi, interior = cfg[d] if d < len(cfg) else (0, 0, 0)
+            if spec[d] is None:
+                continue
+            if lo == 0 and hi == 0 and interior == 0:
+                out[d] = spec[d]
             else:
                 self._record(prim, "all_gather", spec[d],
                              self._local_bytes(a, spec))
@@ -534,9 +568,15 @@ class _Propagator:
         if prim == "transpose":
             return self._rule_transpose(prim, params, in_specs, in_avals,
                                         out_avals)
-        if prim == "slice":
+        if prim in ("slice", "dynamic_slice"):
             return self._rule_slice(prim, params, in_specs, in_avals,
                                     out_avals)
+        if prim == "dynamic_update_slice":
+            return self._rule_dus(prim, params, in_specs, in_avals,
+                                  out_avals)
+        if prim == "pad":
+            return self._rule_pad(prim, params, in_specs, in_avals,
+                                  out_avals)
         if prim == "concatenate":
             return self._rule_concatenate(prim, params, in_specs,
                                           in_avals, out_avals)

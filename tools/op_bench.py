@@ -138,6 +138,29 @@ def _cases(quick=False):
         }
         return functools.partial(step, params, opt_state), (batch,)
 
+    def llama_decode():
+        # Generation rung: prefill + 16 greedy decode steps as the one
+        # compiled scan models/decoding.py serves — gates KV-cache
+        # decode throughput the way llama_train_step gates training.
+        import functools
+
+        from paddle_tpu.models.llama import (LlamaConfig, generate,
+                                             init_params)
+
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=512,
+            dtype=jnp.float32, use_remat=False)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                             jnp.int32)
+
+        def decode(params, prompt):
+            return generate(cfg, params, prompt, max_new_tokens=16)
+        return functools.partial(decode, params), (prompt,)
+
     return {
         "matmul_bf16": matmul,
         "flash_attention": flash_attention,
@@ -146,6 +169,7 @@ def _cases(quick=False):
         "fused_adamw_update": fused_adamw_update,
         "softmax_ce": softmax_ce,
         "llama_train_step": llama_train_step,
+        "llama_decode": llama_decode,
     }
 
 
