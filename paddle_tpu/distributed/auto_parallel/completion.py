@@ -286,6 +286,11 @@ class _Propagator:
                 # reach the owning shard
                 self._record(prim, "all_gather", spec[d],
                              self._local_bytes(u, upd_spec))
+            elif upd_spec[d] is not None and upd_spec[d] != spec[d]:
+                # update sharded where the operand's layout differs:
+                # GSPMD reshards the update to the operand's layout
+                self._record(prim, "all_gather", upd_spec[d],
+                             self._local_bytes(u, upd_spec))
         return [tuple(out)]
 
     def _rule_pad(self, prim, params, in_specs, in_avals, out_avals):
@@ -596,6 +601,34 @@ class _Propagator:
                 if i < len(a.shape) and a.shape[i] == o.shape[d]:
                     out_spec[d] = in_specs[0][i]
             return [tuple(out_spec)]
+        if prim in ("cumsum", "cumprod", "cummax", "cummin",
+                    "cumlogsumexp", "sort", "rev"):
+            # same OUTPUT shape but data mixes ALONG a dim: elementwise
+            # treatment would silently predict zero collectives for a
+            # scan/sort over a sharded dim. Conservative: gather the
+            # operated dims' axes, keep the rest.
+            dims = params.get("dimensions")  # rev
+            if dims is None:
+                d1 = params.get("dimension", params.get("axis"))
+                dims = () if d1 is None else (d1,)  # sort / cum*
+            dims = tuple(d for d in dims if d is not None)
+            # variadic sort carries (keys, values, ...): EVERY operand's
+            # sharding matters and each output mirrors its own operand
+            outs = []
+            for i, o in enumerate(out_avals):
+                spec = in_specs[i] if i < len(in_specs) else ()
+                a = in_avals[i] if i < len(in_avals) else out_avals[i]
+                out_spec = list(_norm_spec(spec, len(o.shape)))
+                lost = [out_spec[d] for d in dims
+                        if d < len(out_spec) and out_spec[d] is not None]
+                self._record_gathers(prim, a,
+                                     tuple(_norm_spec(spec, np.ndim(a))),
+                                     lost)
+                for d in dims:
+                    if d < len(out_spec):
+                        out_spec[d] = None
+                outs.append(tuple(out_spec))
+            return outs
         if prim in ("convert_element_type", "copy",
                     "stop_gradient", "integer_pow", "squeeze"):
             spec = in_specs[0] if in_specs else ()

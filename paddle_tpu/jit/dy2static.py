@@ -124,6 +124,32 @@ def convert_and_not(cond, flag):
         not bool(getattr(flag, "_array", flag))
 
 
+def convert_assert(test, msg=None):
+    """Runtime dispatch for a rewritten ``assert`` (reference:
+    dy2static convert_assert -> the Assert op). Concrete predicates
+    keep python semantics; a TRACED predicate becomes a host callback
+    that raises at RUN time (surfaced as JaxRuntimeError carrying the
+    assertion message) — instead of the bare TracerBoolConversionError
+    a python assert would die with at trace time."""
+    import numpy as np
+
+    v = getattr(test, "_array", test)
+    if _is_traced(test):
+        import jax
+
+        def _check(ok):
+            if not np.all(np.asarray(ok)):
+                m = msg() if callable(msg) else msg
+                raise AssertionError(
+                    m if m is not None else "Assert failed on a "
+                    "traced predicate inside a to_static function")
+        jax.debug.callback(_check, v)
+        return
+    if not bool(np.all(np.asarray(v))):
+        m = msg() if callable(msg) else msg
+        raise AssertionError(m) if m is not None else AssertionError()
+
+
 def convert_flag_off(flag):
     """1 when the flag is unset, 0 when set (traced-aware) — multiplies
     the for-loop index bump so `break` preserves the loop variable
@@ -577,6 +603,26 @@ class _Rewriter(ast.NodeTransformer):
                 if n in defined and n not in must_carry}
 
     # -- transforms ----------------------------------------------------
+    def visit_Assert(self, node):
+        """assert test, msg -> __pt_assert(test, msg): traced
+        predicates become run-time checks instead of trace-time
+        TracerBoolConversionErrors (reference: convert_assert)."""
+        self.generic_visit(node)
+        # the message rides in a lambda: python evaluates an assert's
+        # message LAZILY (only on failure) — `assert not errs, errs[0]`
+        # must not crash on the passing path
+        msg_arg = ast.Constant(value=None) if node.msg is None else \
+            ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[],
+                                   kwonlyargs=[], kw_defaults=[],
+                                   defaults=[]),
+                body=node.msg)
+        call = ast.Expr(value=ast.Call(
+            func=ast.Name(id="__pt_assert", ctx=ast.Load()),
+            args=[node.test, msg_arg], keywords=[]))
+        self.converted += 1
+        return ast.copy_location(call, node)
+
     def visit_If(self, node):
         live = self._live
         node.body = self._visit_block(node.body, live)
@@ -868,6 +914,7 @@ def convert_to_static(fn: Callable) -> Callable:
     glb.setdefault("__pt_not_any", convert_not_any)
     glb.setdefault("__pt_and_not", convert_and_not)
     glb.setdefault("__pt_flag_off", convert_flag_off)
+    glb.setdefault("__pt_assert", convert_assert)
     loc: Dict[str, Any] = {}
     code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
                    mode="exec")

@@ -282,8 +282,6 @@ def test_plan_mesh_real_llama():
     row = {"wo", "w_down"}
 
     def make(mesh_dims):
-        batch = {"input_ids": np.zeros((32, 16), np.int32),
-                 "labels": np.zeros((32, 16), np.int32)}
         lsp = {}
         for k, a in params["layers"].items():
             sp = [None] * a.ndim
@@ -341,6 +339,33 @@ def test_scan_xs_sharded_on_scan_dim_not_silent(mesh):
     # per-iteration payload: one full (H, H) layer slice (each of the
     # mp=4 devices owns exactly one of the L=4 layers)
     assert xs_reshards[0].nbytes == H * H * 4
+
+
+def test_cumsum_sort_dimwise_not_silently_elementwise(mesh):
+    """cumsum/sort keep the output SHAPE but mix data along a dim —
+    the elementwise fast path must not claim zero collectives when
+    that dim is sharded; along an unsharded dim both sides are clean
+    and the batch shard survives."""
+    from paddle_tpu.distributed.auto_parallel.completion import (
+        propagate_sharding)
+
+    x = np.zeros((8, 16), np.float32)
+    rep = propagate_sharding(lambda x: jnp.cumsum(x, axis=0), (x,),
+                             [("dp", None)], mesh_dims={"dp": 2})
+    assert any(r.prim == "cumsum" for r in rep.reshards), rep.reshards
+
+    res = validate_propagation(lambda x: jnp.cumsum(x, axis=1) * 2.0,
+                               (jnp.zeros((8, 16), jnp.float32),),
+                               [("dp", None)], mesh)
+    _check(res)
+    assert not res["actual"]["counts"]
+    assert res["report"].out_specs[0][0] == "dp"
+
+    res = validate_propagation(lambda x: jnp.sort(x, axis=1) * 2.0,
+                               (jnp.zeros((8, 16), jnp.float32),),
+                               [("dp", None)], mesh)
+    _check(res)
+    assert not res["actual"]["counts"]
 
 
 def test_fold_rs_ag_semantics():

@@ -629,3 +629,37 @@ def test_static_leaf_type_distinguished():
     x = paddle.to_tensor(np.array([1.0], np.float32))
     np.testing.assert_allclose(f(x, True).numpy(), [2.0])
     np.testing.assert_allclose(f(x, 1).numpy(), [3.0])
+
+
+def test_assert_on_traced_predicate_checks_at_runtime():
+    """assert on a tensor predicate (reference: convert_assert -> the
+    Assert op): passes silently when true, raises AT RUN TIME with the
+    user's message when false — never a trace-time
+    TracerBoolConversionError."""
+    import pytest
+
+    @paddle.jit.to_static
+    def f(x):
+        assert (x > 0).all(), "x must be positive"
+        return x * 2.0
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([1.0, 2.0], np.float32))).numpy(),
+        [2.0, 4.0])
+    with pytest.raises(Exception, match="x must be positive"):
+        out = f(paddle.to_tensor(np.array([-1.0, 2.0], np.float32)))
+        np.asarray(out.numpy())  # sync: callback errors surface here
+
+
+def test_assert_concrete_keeps_python_semantics():
+    import pytest
+
+    @paddle.jit.to_static
+    def f(x, n):
+        assert n > 0, "n must be positive"
+        return x * float(n)
+
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(f(x, 2).numpy(), [6.0])
+    with pytest.raises(AssertionError, match="n must be positive"):
+        f(x, 0)

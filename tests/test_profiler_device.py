@@ -17,10 +17,12 @@ def test_record_event_stats_and_summary_table():
     p = prof.Profiler(timer_only=True)
     p.start()
     for _ in range(3):
+        # 20x margin: under a loaded host a short sleep can overshoot
+        # by several ms — the ordering assertion below must not flip
         with prof.RecordEvent("forward"):
-            time.sleep(0.002)
+            time.sleep(0.001)
         with prof.RecordEvent("backward"):
-            time.sleep(0.004)
+            time.sleep(0.020)
         p.step()
     p.stop()
     table = p.summary_table()
