@@ -23,6 +23,12 @@ from .core.dtype import (bool_ as bool8, uint8, int8, int16, int32, int64,
                          finfo, iinfo)
 from .framework.random import seed, get_rng_state, set_rng_state
 from .framework.param_attr import ParamAttr
+from .compat import (dtype, batch, tolist, check_shape, CUDAPlace,
+                     CUDAPinnedPlace, NPUPlace, get_cuda_rng_state,
+                     set_cuda_rng_state)
+from .core.dtype import bool_ as bool  # noqa: A001 — reference exports
+# paddle.bool as a dtype name (shadows the builtin inside this
+# namespace only, exactly as the reference does)
 
 from .tensor import *  # noqa: F401,F403 — the ~200-op tensor surface
 from .tensor import logic as _logic

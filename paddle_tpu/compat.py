@@ -1,0 +1,123 @@
+"""Reference-API compatibility shims for the last few top-level names.
+
+These close the gap between the reference's ``paddle/__init__.py``
+``__all__`` (283 names) and this package, so scripts written against
+the reference import-cleanly. CUDA-specific names map to this stack's
+device reality with a one-time warning — code that *selects* a CUDA
+place keeps running on the accelerator that actually exists
+(reference: paddle/fluid/core.py CUDAPlace, paddle/__init__.py
+get_cuda_rng_state).
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.place import TPUPlace, _default_place
+from .framework.random import get_rng_state, set_rng_state
+
+__all__ = ["dtype", "batch", "tolist", "check_shape", "CUDAPlace",
+           "CUDAPinnedPlace", "NPUPlace", "get_cuda_rng_state",
+           "set_cuda_rng_state"]
+
+# isinstance(x, paddle.dtype) parity: dtypes on this stack are numpy
+# dtype objects (jnp.float32 etc. are scalar-type aliases coercible
+# via np.dtype)
+dtype = np.dtype
+
+
+def _mapped_place(kind, device_id=0):
+    warnings.warn(
+        f"{kind}({device_id}) requested on a TPU-native build: mapping "
+        "to the available accelerator place (there is no CUDA device "
+        "here; computation runs where XLA put it)", stacklevel=3)
+    p = _default_place()
+    return p if not isinstance(p, TPUPlace) else TPUPlace(device_id)
+
+
+class CUDAPlace:
+    """reference: fluid/core CUDAPlace — compat constructor returning
+    the place this build actually computes on."""
+
+    def __new__(cls, device_id=0):
+        return _mapped_place("CUDAPlace", device_id)
+
+
+class CUDAPinnedPlace:
+    def __new__(cls):
+        return _mapped_place("CUDAPinnedPlace")
+
+
+class NPUPlace:
+    def __new__(cls, device_id=0):
+        return _mapped_place("NPUPlace", device_id)
+
+
+def get_cuda_rng_state():
+    """reference: paddle.get_cuda_rng_state — one RNG state per device.
+    Here the framework keeps a single splittable key; returned as a
+    one-element list to match the per-device-list contract."""
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    states = state_list if isinstance(state_list, (list, tuple)) \
+        else [state_list]
+    if states:
+        set_rng_state(states[0])
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: paddle/batch.py — the legacy reader combinator:
+    sample-yielding callable -> batch-yielding callable."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def tolist(x):
+    """reference: tensor/to_string tolist — nested python lists."""
+    arr = getattr(x, "_array", x)
+    return np.asarray(arr).tolist()
+
+
+def check_shape(shape, op_name="check_shape",
+                expected_shape_type=(list, tuple),
+                expected_element_type=(int,),
+                expected_tensor_dtype=("int32", "int64")):
+    """reference: fluid/data_feeder.py check_shape — validate a shape
+    argument: a list/tuple of ints, or an integer Tensor."""
+    from .core.tensor import Tensor
+
+    if isinstance(shape, Tensor):
+        if str(shape.dtype) not in ("int32", "int64") and \
+                shape._array.dtype not in (jnp.int32, jnp.int64):
+            raise TypeError(
+                f"{op_name}: a Tensor shape must be int32/int64, got "
+                f"{shape._array.dtype}")
+        return
+    if not isinstance(shape, expected_shape_type):
+        raise TypeError(
+            f"{op_name}: shape must be {expected_shape_type} or an "
+            f"integer Tensor, got {type(shape)}")
+    for item in shape:
+        if isinstance(item, Tensor):
+            continue
+        if not isinstance(item, expected_element_type) or \
+                isinstance(item, bool):
+            raise TypeError(
+                f"{op_name}: shape elements must be ints, got "
+                f"{type(item)}")

@@ -10,7 +10,12 @@ from .collective import (ReduceOp, Group, new_group, get_group, all_reduce,
                          send, recv, isend, irecv, reduce_scatter, barrier,
                          get_rank, get_world_size, get_backend,
                          is_initialized, destroy_process_group, wait,
-                         stream)
+                         stream, broadcast_object_list,
+                         scatter_object_list, gloo_barrier, gloo_release)
+from .ps_dataset import (InMemoryDataset, QueueDataset, CountFilterEntry,
+                         ShowClickEntry, ProbabilityEntry, ParallelMode,
+                         is_available)
+from . import io
 from .parallel import (init_parallel_env, shutdown, ParallelEnv,
                        DataParallel)
 from .mesh import (HybridTopology, init_mesh, get_mesh, set_mesh,
@@ -38,7 +43,10 @@ __all__ = [
     "gloo_init_parallel_env", "shutdown_process_group", "split",
     "get_rank", "get_world_size", "is_initialized", "destroy_process_group",
     "wait", "stream", "init_parallel_env", "shutdown", "ParallelEnv",
-    "DataParallel",
+    "DataParallel", "broadcast_object_list", "scatter_object_list",
+    "gloo_barrier", "gloo_release", "InMemoryDataset", "QueueDataset",
+    "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry",
+    "ParallelMode", "is_available", "io",
     "HybridTopology", "init_mesh", "get_mesh", "set_mesh", "get_topology",
     "ProcessMesh", "PartitionSpec", "NamedSharding", "shard_tensor",
     "shard_op", "shard_layer", "with_sharding_constraint", "shard_params",

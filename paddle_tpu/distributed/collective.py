@@ -364,3 +364,32 @@ def get_backend(group=None):
     """reference: collective.py get_backend — the one backend here is XLA
     collectives over ICI/DCN."""
     return "XCCL"
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: broadcast_object_list — single-process eager facade:
+    src's objects are already the local list (world of 1); multi-host
+    object broadcast rides the TCPStore (store.set/wait) in the gang
+    scripts."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: scatter_object_list — world-of-1 facade: rank 0 keeps
+    its slice."""
+    if in_object_list:
+        out_object_list.clear()
+        out_object_list.append(in_object_list[get_rank(group) %
+                                              len(in_object_list)])
+    return out_object_list
+
+
+def gloo_barrier():
+    """reference: gloo_barrier — CPU-side barrier; maps to the device
+    barrier (single-process) / store barrier in gang scripts."""
+    barrier()
+
+
+def gloo_release():
+    """reference: gloo_release — nothing to free on this stack."""

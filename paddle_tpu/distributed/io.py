@@ -1,0 +1,54 @@
+"""paddle.distributed.io — distributed persistence helpers.
+
+Reference analog: python/paddle/distributed/io.py
+(save_persistables/load_persistables for PS trainers + is_persistable).
+
+TPU-native: persistence rides framework.io's save/load (orbax handles
+the genuinely distributed checkpoints in distributed/checkpoint.py);
+these wrappers keep the reference's entry points for PS-style scripts.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    """A parameter or buffer persists; activations don't. On this stack
+    that is 'any named Tensor a Layer owns'."""
+    from ..core.tensor import Tensor
+    return isinstance(var, Tensor) and not getattr(
+        var, "_is_temporary", False)
+
+
+def save_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    """Save a static Program's (or a Layer's) persistable state.
+    ``executor`` is accepted for signature parity; state comes from the
+    program bound by minimize()/run."""
+    from ..framework.io import save
+
+    prog = main_program
+    if prog is None:
+        from ..static.program import default_main_program
+        prog = default_main_program()
+    state = getattr(prog, "state_dict", lambda: {})()
+    os.makedirs(dirname, exist_ok=True)
+    save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    from ..framework.io import load
+
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = load(path)
+    prog = main_program
+    if prog is None:
+        from ..static.program import default_main_program
+        prog = default_main_program()
+    setter = getattr(prog, "set_state_dict", None)
+    if setter is not None:
+        setter(state)
+    return state

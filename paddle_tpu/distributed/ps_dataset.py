@@ -1,0 +1,145 @@
+"""PS-era dataset surface: InMemoryDataset / QueueDataset + table
+entry configs.
+
+Reference analog: python/paddle/distributed/fleet/dataset/dataset.py
+(DatasetBase/InMemoryDataset/QueueDataset over the C++ MultiSlotDataset
+feeders) and the sparse-table accessor entry configs
+(CountFilterEntry etc. in distributed/ps/the_one_ps.py).
+
+TPU-native scope: the reference couples these to its C++ data-feed +
+PS runtime; here they are honest host-side file datasets that plug
+into ``paddle.io.DataLoader`` (and the HostEmbedding PS capability):
+``set_filelist`` names text files, ``load_into_memory`` materializes
+lines (InMemoryDataset) or leaves them streaming (QueueDataset), and
+``slot`` parsing splits whitespace-delimited records. pipe_command
+shelling is intentionally unsupported — pass a python ``parse_fn``
+instead (raises with that guidance if configured).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+__all__ = ["InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ShowClickEntry", "ProbabilityEntry", "ParallelMode",
+           "is_available"]
+
+
+class _Entry:
+    """Sparse-table accessor entry config (tiny value object)."""
+
+    def __init__(self, **kw):
+        self._config = dict(kw)
+
+    def __repr__(self):
+        kv = ", ".join(f"{k}={v}" for k, v in self._config.items())
+        return f"{type(self).__name__}({kv})"
+
+
+class CountFilterEntry(_Entry):
+    """reference: show/click count threshold filter for sparse ids."""
+
+    def __init__(self, count_filter_threshold=0.7):
+        super().__init__(count_filter_threshold=count_filter_threshold)
+
+
+class ShowClickEntry(_Entry):
+    """reference: names the show/click input slots of a CTR accessor."""
+
+    def __init__(self, show_slot="show", click_slot="click"):
+        super().__init__(show_slot=show_slot, click_slot=click_slot)
+
+
+class ProbabilityEntry(_Entry):
+    """reference: probabilistic admission of new sparse ids."""
+
+    def __init__(self, probability=1.0):
+        super().__init__(probability=probability)
+
+
+class _FileDataset:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._parse_fn: Optional[Callable[[str], object]] = None
+        self._batch_size = 1
+        self._lines: Optional[List[object]] = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             parse_fn=None, **kwargs):
+        if pipe_command:
+            raise NotImplementedError(
+                "pipe_command shells a C++ data feed in the reference; "
+                "pass parse_fn=<callable(line) -> sample> instead")
+        self._batch_size = int(batch_size)
+        self._parse_fn = parse_fn
+        return self
+
+    # paddle's private-config spelling
+    _init_distributed_settings = init
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _iter_lines(self):
+        for path in self._filelist:
+            if not os.path.exists(path):
+                raise FileNotFoundError(path)
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._parse_fn(line) if self._parse_fn else line
+
+
+class InMemoryDataset(_FileDataset):
+    """Materializes every record in host RAM (the shuffle-capable
+    variant; reference dataset.py InMemoryDataset)."""
+
+    def load_into_memory(self):
+        self._lines = list(self._iter_lines())
+
+    def get_memory_data_size(self):
+        return len(self._lines or [])
+
+    def local_shuffle(self, seed=0):
+        import random
+        if self._lines is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.Random(seed).shuffle(self._lines)
+
+    global_shuffle = local_shuffle  # one-host build: same pool
+
+    def release_memory(self):
+        self._lines = None
+
+    def __len__(self):
+        if self._lines is None:
+            raise RuntimeError("call load_into_memory() first")
+        return len(self._lines)
+
+    def __getitem__(self, i):
+        if self._lines is None:
+            raise RuntimeError("call load_into_memory() first")
+        return self._lines[i]
+
+
+class QueueDataset(_FileDataset):
+    """Streams records file-by-file without materializing (reference
+    QueueDataset): an iterable dataset for paddle.io.DataLoader."""
+
+    def __iter__(self):
+        return self._iter_lines()
+
+
+class ParallelMode:
+    """reference: distributed/parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def is_available():
+    """reference: distributed.is_available — the communication package
+    is always built into this stack."""
+    return True

@@ -1,6 +1,116 @@
-"""paddle.incubate parity: fused nn ops, autograd extras, MoE, ASP."""
+"""paddle.incubate parity: fused nn ops, autograd extras, MoE, ASP.
+
+Top-level names mirror the reference's incubate/__init__.py __all__:
+the optimizer wrappers re-export from .optimizer, the graph/segment
+family re-exports the geometric implementations under their incubate
+aliases, and the softmax-mask fusions are jnp expressions XLA fuses
+(the capability the reference's fused CUDA kernels exist for)."""
 from . import nn
 from . import autograd
 from . import asp
 from . import autotune
 from . import optimizer
+from .optimizer import LookAhead, ModelAverage
+
+# reference: incubate.graph_* are the pre-paddle.geometric names of the
+# same ops (python/paddle/incubate/operators/graph_send_recv.py etc.)
+from ..geometric import (segment_sum, segment_mean, segment_max,
+                         segment_min)
+from ..geometric import send_u_recv as graph_send_recv
+from ..geometric import sample_neighbors as graph_sample_neighbors
+from ..geometric import reindex_graph as graph_reindex
+
+__all__ = ["nn", "autograd", "asp", "autotune", "optimizer",
+           "LookAhead", "ModelAverage",
+           "segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "graph_sample_neighbors", "graph_reindex",
+           "graph_khop_sampler", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate/operators/softmax_mask_fuse.py — fused
+    softmax(x + mask) for attention scores; XLA fuses the additive mask
+    into the softmax the way the hand-written CUDA kernel does."""
+    import jax
+    from ..core.tensor import apply_op, Tensor
+    xs = x if isinstance(x, Tensor) else Tensor(x)
+    ms = mask if isinstance(mask, Tensor) else Tensor(mask)
+    return apply_op(lambda a, m: jax.nn.softmax(a + m, axis=-1), xs, ms,
+                    op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """reference: softmax_mask_fuse_upper_triangle — causal-masked
+    softmax over the last two dims ([..., S, S] scores)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import apply_op, Tensor
+    xs = x if isinstance(x, Tensor) else Tensor(x)
+
+    def f(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        neg = jnp.asarray(jnp.finfo(
+            a.dtype if jnp.issubdtype(a.dtype, jnp.floating)
+            else jnp.float32).min, a.dtype)
+        return jax.nn.softmax(jnp.where(causal, a, neg), axis=-1)
+    return apply_op(f, xs, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate/operators/graph_khop_sampler.py — multi-hop
+    neighbor sampling: chain sample_neighbors over k hops, reindexing
+    the union frontier each hop. Returns (edge_src, edge_dst,
+    sample_index, reindex_nodes) like the reference (eids appended when
+    requested)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    from ..geometric import reindex_graph, sample_neighbors
+
+    nodes = input_nodes
+    all_src, all_dst = [], []
+    frontier = nodes
+    for k in sample_sizes:
+        out = sample_neighbors(row, colptr, frontier, sample_size=k)
+        neighbors, counts = out[0], out[1]
+        all_src.append(np.asarray(
+            neighbors._array if isinstance(neighbors, Tensor)
+            else neighbors))
+        cnt = np.asarray(counts._array if isinstance(counts, Tensor)
+                         else counts)
+        fr = np.asarray(frontier._array if isinstance(frontier, Tensor)
+                        else frontier)
+        all_dst.append(np.repeat(fr, cnt))
+        # next frontier: unique new neighbors (discovery order)
+        flat = all_src[-1]
+        _, first = np.unique(flat, return_index=True)
+        frontier = Tensor(flat[np.sort(first)])
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    base = np.asarray(input_nodes._array
+                      if isinstance(input_nodes, Tensor) else input_nodes)
+    union = np.concatenate([base, src])
+    _, first = np.unique(union, return_index=True)
+    sample_index = union[np.sort(first)]
+    remap = {int(v): i for i, v in enumerate(sample_index)}
+    src_re = np.asarray([remap[int(v)] for v in src], np.int64)
+    dst_re = np.asarray([remap[int(v)] for v in dst], np.int64)
+    return (Tensor(src_re), Tensor(dst_re), Tensor(sample_index),
+            Tensor(np.arange(len(sample_index), dtype=np.int64)))
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate.identity_loss — marks a value as the loss
+    with an explicit reduction (1=sum, 2=mean, 0/none=identity)."""
+    from ..tensor import math as _m
+    red = {0: "none", 1: "sum", 2: "mean"}.get(reduction, reduction)
+    if red == "sum":
+        return _m.sum(x)
+    if red == "mean":
+        return _m.mean(x)
+    return x
+
+
+__all__ += ["identity_loss"]

@@ -145,7 +145,14 @@ def convert_assert(test, msg=None):
                     "traced predicate inside a to_static function")
         jax.debug.callback(_check, v)
         return
-    if not bool(np.all(np.asarray(v))):
+    # concrete: PYTHON truthiness ('assert items' on a non-empty list
+    # must pass); np.all only for array-valued predicates, whose bool()
+    # would be ambiguous
+    if isinstance(v, np.ndarray) or hasattr(v, "ndim"):
+        ok = bool(np.all(np.asarray(v)))
+    else:
+        ok = bool(test)
+    if not ok:
         m = msg() if callable(msg) else msg
         raise AssertionError(m) if m is not None else AssertionError()
 

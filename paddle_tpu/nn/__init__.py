@@ -12,6 +12,7 @@ from .layer.rnn import *  # noqa: F401,F403
 from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,
                                 TransformerEncoder, TransformerDecoderLayer,
                                 TransformerDecoder, Transformer)
+from .decode import BeamSearchDecoder, dynamic_decode
 from .clip import (ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)
 from . import functional
 from . import initializer

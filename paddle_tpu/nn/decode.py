@@ -1,0 +1,163 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference analog: python/paddle/nn/decode.py (BeamSearchDecoder over
+RNN cells, dynamic_decode's step loop with finished tracking and
+parent-id backtracking).
+
+TPU-native note: this is the CELL-level decoding API for seq2seq RNN
+models, run as a host-stepped loop (states are tiny; per-step
+collectives don't exist here). LLM generation takes the other path —
+models/decoding.py compiles the whole KV-cache decode loop into one
+``lax.scan``. Both are first-class; they serve different model
+families, exactly as the reference splits nn.decode from
+fused_multi_transformer generation.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _arr(x):
+    return getattr(x, "_array", x)
+
+
+def _map_states(fn, states):
+    return jax.tree_util.tree_map(
+        lambda a: fn(_arr(a)), states,
+        is_leaf=lambda x: isinstance(x, (Tensor, jnp.ndarray, np.ndarray)))
+
+
+class BeamSearchDecoder:
+    """Beam search over a step cell (reference: decode.py:33).
+
+    cell(inputs, states) -> (outputs, new_states); ``embedding_fn``
+    maps token ids to cell inputs; ``output_fn`` maps cell outputs to
+    vocabulary logits (identity when the cell already emits logits).
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers ------------------------------------------------------------
+    def _tile(self, a):
+        """[B, ...] -> [B*beam, ...] (tile_beam_merge_with_batch)."""
+        a = _arr(a)
+        return jnp.repeat(a, self.beam_size, axis=0)
+
+    tile_beam_merge_with_batch = _tile
+
+    def initialize(self, initial_cell_states):
+        states = _map_states(self._tile, initial_cell_states)
+        # beam 0 live, others -inf: the first expansion must not pick
+        # `beam_size` copies of the same token
+        return states
+
+    def _logits_of(self, cell_out):
+        out = cell_out[0] if isinstance(cell_out, (tuple, list)) \
+            else cell_out
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return _arr(out)
+
+    def step(self, tokens, states):
+        """One expansion: tokens [B*beam] -> (log_probs [B*beam, V],
+        new_states)."""
+        inputs = tokens
+        if self.embedding_fn is not None:
+            inputs = self.embedding_fn(tokens)
+        inputs_t = inputs if isinstance(inputs, Tensor) \
+            else Tensor(jnp.asarray(_arr(inputs)))
+        states_t = _map_states(lambda a: Tensor(a), states)
+        out = self.cell(inputs_t, states_t)
+        cell_out, new_states = out if isinstance(out, tuple) and \
+            len(out) == 2 else (out, states_t)
+        logits = self._logits_of(cell_out)
+        new_states = _map_states(lambda a: a, new_states)
+        return jax.nn.log_softmax(logits, axis=-1), new_states
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 100, output_time_major: bool = False,
+                   impute_finished: bool = True, is_test: bool = False,
+                   return_length: bool = False, **kwargs):
+    """Run the decoder to completion (reference: decode.py:605
+    dynamic_decode): expand beams until every beam emitted end_token or
+    ``max_step_num`` steps elapsed, then backtrack parent ids into
+    final token sequences.
+
+    Returns (predicted_ids, sequence_lengths) with predicted_ids
+    [B, T, beam] (or [T, B, beam] when ``output_time_major``), beams
+    sorted best-first by accumulated log-prob.
+    """
+    beam = decoder.beam_size
+    states = decoder.initialize(inits)
+    leaves = jax.tree_util.tree_leaves(states)
+    if not leaves:
+        raise ValueError("dynamic_decode needs initial cell states "
+                         "(pass inits=cell.get_initial_states(...))")
+    B = int(np.asarray(_arr(leaves[0])).shape[0]) // beam
+
+    tokens = jnp.full((B * beam,), decoder.start_token, jnp.int32)
+    scores = jnp.where(jnp.arange(B * beam) % beam == 0, 0.0, -np.inf)
+    finished = jnp.zeros((B * beam,), bool)
+    step_tokens, step_parents = [], []
+    lengths = jnp.zeros((B * beam,), jnp.int32)
+
+    for t in range(int(max_step_num)):
+        log_probs, new_states = decoder.step(tokens, states)
+        V = log_probs.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        fin_row = jnp.full((V,), -np.inf).at[decoder.end_token].set(0.0)
+        log_probs = jnp.where(finished[:, None], fin_row, log_probs)
+        cand = scores[:, None] + log_probs              # [B*beam, V]
+        cand = cand.reshape(B, beam * V)
+        top_v, top_i = jax.lax.top_k(cand, beam)        # [B, beam]
+        parent = top_i // V                             # beam index
+        tok = (top_i % V).astype(jnp.int32)
+        # flat gather indices into the expanded batch
+        gather = (jnp.arange(B)[:, None] * beam + parent).reshape(-1)
+        states = _map_states(lambda a: a[gather], new_states)
+        prev_finished = finished[gather]
+        tokens = tok.reshape(-1)
+        scores = top_v.reshape(-1)
+        lengths = jnp.where(prev_finished, lengths[gather],
+                            lengths[gather] + 1)
+        finished = prev_finished | (tokens == decoder.end_token)
+        step_tokens.append(tokens.reshape(B, beam))
+        step_parents.append(parent)
+        if bool(jnp.all(finished)):
+            break
+
+    # backtrack parent ids (reference: gather_tree)
+    T = len(step_tokens)
+    ids = np.zeros((B, T, beam), np.int32)
+    cur = np.tile(np.arange(beam), (B, 1))
+    for t in range(T - 1, -1, -1):
+        ids[:, t, :] = np.take_along_axis(
+            np.asarray(step_tokens[t]), cur, axis=1)
+        cur = np.take_along_axis(np.asarray(step_parents[t]), cur, axis=1)
+
+    if impute_finished:
+        # replace everything after each beam's first end_token with it
+        done = np.cumsum(ids == decoder.end_token, axis=1) > 0
+        shifted = np.roll(done, 1, axis=1)
+        shifted[:, 0, :] = False
+        ids = np.where(shifted, decoder.end_token, ids)
+
+    seq_len = Tensor(lengths.reshape(B, beam))
+    out = np.transpose(ids, (1, 0, 2)) if output_time_major else ids
+    return Tensor(jnp.asarray(out)), seq_len

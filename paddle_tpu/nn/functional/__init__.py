@@ -8,3 +8,16 @@ from .loss import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
 from .extended import *  # noqa: F401,F403
 from ...tensor.manipulation import pad  # noqa: F401
+
+# reference parity extras: inplace activation variants ride the shared
+# tensor inplace machinery; diag_embed lives on the tensor surface;
+# sparse_attention is the incubate implementation re-exported
+from ...tensor.extras import _inplace as _mk_inplace  # noqa: E402
+from .activation import elu, softmax, tanh  # noqa: E402
+
+elu_ = _mk_inplace(elu)
+softmax_ = _mk_inplace(softmax)
+tanh_ = _mk_inplace(tanh)
+
+from ...tensor.creation import diag_embed  # noqa: E402,F401
+from ...incubate.nn.functional import sparse_attention  # noqa: E402,F401

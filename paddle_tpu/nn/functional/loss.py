@@ -546,3 +546,83 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
 
 for _n in __all__:
     register(_n, globals()[_n])
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """reference: nn/functional/loss.py dice_loss — 1 - 2|X∩Y|/(|X|+|Y|)
+    over the class probabilities of segmentation logits. input
+    [N, ..., C] probabilities; label [N, ..., 1] int."""
+    input = _ensure_tensor(input)  # noqa: A001
+    label = _ensure_tensor(label)
+
+    def _f(p, y):
+        import jax
+        num_classes = p.shape[-1]
+        oh = jax.nn.one_hot(jnp.squeeze(y, -1), num_classes,
+                            dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(oh,
+                                                       axis=reduce_dims)
+        dice = (2.0 * inter + epsilon) / (union + epsilon)
+        return jnp.mean(1.0 - dice)
+    return apply_op(_f, input, label, op_name="dice_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0,  # noqa: A002
+                      weight=None, reduction="mean", name=None):
+    """reference: multi_margin_loss — mean_j max(0, margin - x[y] +
+    x[j])^p over j != y, per sample."""
+    input = _ensure_tensor(input)  # noqa: A001
+    label = _ensure_tensor(label)
+    args = [input, label] + ([_ensure_tensor(weight)]
+                             if weight is not None else [])
+
+    def _f(x, y, *w):
+        C = x.shape[-1]
+        correct = jnp.take_along_axis(x, y[:, None], axis=-1)
+        per = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            per = per * w[0][y][:, None]
+        mask = 1.0 - jax.nn.one_hot(y, C, dtype=x.dtype)
+        per = jnp.sum(per * mask, axis=-1) / C
+        return _reduce(per, reduction)
+    return apply_op(_f, *args, op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference: triplet_margin_with_distance_loss — triplet loss
+    with a caller-supplied distance callable (defaults to pairwise
+    L2)."""
+    input = _ensure_tensor(input)  # noqa: A001
+    positive = _ensure_tensor(positive)
+    negative = _ensure_tensor(negative)
+    if distance_function is None:
+        def distance_function(u, v):
+            diff = u - v
+            diff_arr = getattr(diff, "_array", diff)
+            return jnp.sqrt(jnp.maximum(
+                jnp.sum(diff_arr * diff_arr, axis=-1), 1e-12))
+
+    def _f(a, pos, neg):
+        def dist(u, v):
+            d = distance_function(u, v)
+            return getattr(d, "_array", d)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        per = jnp.maximum(d_pos - d_neg + margin, 0)
+        return _reduce(per, reduction)
+    return apply_op(_f, input, positive, negative,
+                    op_name="triplet_margin_with_distance_loss")
+
+
+__all__ += ["dice_loss", "multi_margin_loss",
+            "triplet_margin_with_distance_loss"]
+for _n in ("dice_loss", "multi_margin_loss",
+           "triplet_margin_with_distance_loss"):
+    register(_n, globals()[_n])

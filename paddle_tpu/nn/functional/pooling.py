@@ -76,6 +76,27 @@ def _pool(x, kernel, stride, padding, nd, reducer, init, channels_last,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        # ride the 2-D with-index machinery on a dummy width-1 axis:
+        # flat indices over L*1 ARE the 1-D positions max_unpool1d eats
+        from ...tensor.manipulation import (squeeze, transpose,
+                                            unsqueeze)
+        nlc = data_format == "NLC"
+        xt = _ensure_tensor(x)
+        if nlc:
+            xt = transpose(xt, [0, 2, 1])
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if stride is None or isinstance(stride, int) \
+            else stride[0]
+        p = padding if isinstance(padding, int) else padding[0]
+        out, idx = _max_pool2d_with_index(
+            unsqueeze(xt, -1), (k, 1),
+            (k if s is None else s, 1), (p, 0), False, ceil_mode)
+        out, idx = squeeze(out, -1), squeeze(idx, -1)
+        if nlc:
+            out = transpose(out, [0, 2, 1])
+            idx = transpose(idx, [0, 2, 1])
+        return out, idx
     return _pool(x, kernel_size, stride, padding, 1, lax.max, -jnp.inf,
                  data_format.endswith("C") and data_format != "NCL",
                  ceil_mode, op_name="max_pool1d")
@@ -177,8 +198,63 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool3d_with_index(x, kernel_size, stride, padding,
+                                      data_format == "NDHWC", ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, lax.max, -jnp.inf,
                  data_format == "NDHWC", ceil_mode, op_name="max_pool3d")
+
+
+def _max_pool3d_with_index(x, kernel_size, stride, padding,
+                           channels_last, ceil_mode=False):
+    """max_pool3d(return_mask=True): values + flat argmax index into
+    the input D*H*W volume (max_pool3d_with_index op), the contract
+    max_unpool3d consumes."""
+    x = _ensure_tensor(x)
+    kd, kh, kw = _tuplize(kernel_size, 3)
+    sd, sh, sw = _tuplize(stride if stride is not None else kernel_size, 3)
+    pd, ph, pw = _tuplize(padding, 3)
+
+    def _f(a):
+        if channels_last:
+            a = jnp.moveaxis(a, -1, 1)
+        N, C, D, H, W = a.shape
+
+        def osz(sz, k, s, p):
+            return (-((sz + 2 * p - k) // -s) + 1) if ceil_mode \
+                else (sz + 2 * p - k) // s + 1
+        OD, OH, OW = osz(D, kd, sd, pd), osz(H, kh, sh, ph), \
+            osz(W, kw, sw, pw)
+        ed = (OD - 1) * sd + kd - D - pd
+        eh = (OH - 1) * sh + kh - H - ph
+        ew = (OW - 1) * sw + kw - W - pw
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pd, max(ed, 0)),
+                         (ph, max(eh, 0)), (pw, max(ew, 0))),
+                     constant_values=-jnp.inf)
+        vals, gidx = [], []
+        for dz in range(kd):
+            for dy in range(kh):
+                for dx in range(kw):
+                    vals.append(ap[:, :, dz:dz + sd * OD:sd,
+                                   dy:dy + sh * OH:sh,
+                                   dx:dx + sw * OW:sw])
+                    zz = jnp.arange(OD) * sd + dz - pd
+                    yy = jnp.arange(OH) * sh + dy - ph
+                    xx = jnp.arange(OW) * sw + dx - pw
+                    flat = (zz[:, None, None] * H + yy[None, :, None]) \
+                        * W + xx[None, None, :]
+                    gidx.append(jnp.broadcast_to(
+                        flat, (N, C, OD, OH, OW)))
+        stack = jnp.stack(vals)
+        am = jnp.argmax(stack, axis=0)
+        out = jnp.max(stack, axis=0)
+        idx = jnp.take_along_axis(jnp.stack(gidx), am[None], axis=0)[0]
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+            idx = jnp.moveaxis(idx, 1, -1)
+        return out, idx.astype(jnp.int32)
+
+    return apply_op(_f, x, op_name="max_pool3d_with_index", n_outs=2)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -298,4 +374,76 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
 
 
 for _n in __all__:
+    register(_n, globals()[_n])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """1-D unpool: scatter back by the indices max_pool1d(return_mask)
+    recorded (reference: unpool op, 1-D form)."""
+    if data_format == "NLC":
+        from ...tensor.manipulation import transpose
+        out = max_unpool1d(transpose(_ensure_tensor(x), [0, 2, 1]),
+                           transpose(_ensure_tensor(indices), [0, 2, 1]),
+                           kernel_size, stride, padding, "NCL",
+                           output_size, name)
+        return transpose(out, [0, 2, 1])
+    x = _ensure_tensor(x)
+    indices = _ensure_tensor(indices)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (
+        stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    il = x.shape[-1]
+    ol = output_size[-1] if output_size is not None \
+        else (il - 1) * s - 2 * p + k
+
+    def _f(a, idx):
+        N, C, L = a.shape
+
+        def scatter(one_v, one_i):
+            return jnp.zeros(ol, one_v.dtype).at[one_i].set(one_v)
+        return jax.vmap(jax.vmap(scatter))(
+            a, idx.astype(jnp.int32)).reshape(N, C, ol)
+    return apply_op(_f, x, indices, op_name="max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """3-D unpool: indices are flat positions over the output D*H*W
+    (reference: unpool3d op)."""
+    if data_format == "NDHWC":
+        from ...tensor.manipulation import transpose
+        out = max_unpool3d(
+            transpose(_ensure_tensor(x), [0, 4, 1, 2, 3]),
+            transpose(_ensure_tensor(indices), [0, 4, 1, 2, 3]),
+            kernel_size, stride, padding, "NCDHW", output_size, name)
+        return transpose(out, [0, 2, 3, 4, 1])
+    x = _ensure_tensor(x)
+    indices = _ensure_tensor(indices)
+    kd, kh, kw = _tuplize(kernel_size, 3)
+    sd, sh, sw = _tuplize(stride if stride is not None else kernel_size, 3)
+    pd, ph, pw = _tuplize(padding, 3)
+    idd, ih, iw = x.shape[2:5]
+    if output_size is None:
+        od = (idd - 1) * sd - 2 * pd + kd
+        oh = (ih - 1) * sh - 2 * ph + kh
+        ow = (iw - 1) * sw - 2 * pw + kw
+    else:
+        od, oh, ow = output_size[-3:]
+
+    def _f(a, idx):
+        N, C, D, H, W = a.shape
+        flat_v = a.reshape(N, C, D * H * W)
+        flat_i = idx.reshape(N, C, D * H * W).astype(jnp.int32)
+
+        def scatter(one_v, one_i):
+            return jnp.zeros(od * oh * ow, one_v.dtype).at[one_i].set(one_v)
+        out = jax.vmap(jax.vmap(scatter))(flat_v, flat_i)
+        return out.reshape(N, C, od, oh, ow)
+    return apply_op(_f, x, indices, op_name="max_unpool3d")
+
+
+__all__ += ["max_unpool1d", "max_unpool3d"]
+for _n in ("max_unpool1d", "max_unpool3d"):
     register(_n, globals()[_n])

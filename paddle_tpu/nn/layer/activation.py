@@ -264,3 +264,20 @@ class ThresholdedReLU(Layer):
 
     def forward(self, x):
         return F.thresholded_relu(x, self._threshold, self._value)
+
+
+class Softmax2D(Layer):
+    """reference: nn/layer/activation.py Softmax2D — softmax over the
+    channel dim of NCHW (per spatial position)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects a 3-D or 4-D input, got {x.ndim}-D")
+        return F.softmax(x, axis=-3)
+
+
+__all__ += ["Softmax2D"]

@@ -29,12 +29,14 @@ from ..core.tensor import Tensor
 from .program import (Program, Executor, program_guard,
                       default_main_program, default_startup_program,
                       enable_static, disable_static, in_static_mode, data)
+from .compat import *  # noqa: F401,F403 — persistence/places/legacy shells
+from .compat import __all__ as _compat_all
 
 __all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "Executor", "data", "name_scope",
            "py_func", "save_inference_model", "load_inference_model",
            "gradients", "enable_static", "disable_static",
-           "in_static_mode"]
+           "in_static_mode"] + list(_compat_all)
 
 
 @contextlib.contextmanager

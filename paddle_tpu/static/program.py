@@ -100,6 +100,35 @@ class Program:
     def global_block(self):
         return self
 
+    # -- persistence (reference: Program.state_dict / io.save_persistables)
+    def state_dict(self, mode="all"):
+        """Captured (parameter/constant) tensors by name — what
+        distributed.io.save_persistables persists."""
+        out = {}
+        for i, t in enumerate(self._captured()):
+            out[getattr(t, "name", None) or f"cap_{i}"] = t
+        return out
+
+    def set_state_dict(self, state_dict):
+        caps = self._captured()
+        by_name = {getattr(t, "name", None) or f"cap_{i}": t
+                   for i, t in enumerate(caps)}
+        import jax.numpy as jnp
+        import numpy as np
+        missing = []
+        for k, v in state_dict.items():
+            t = by_name.get(k)
+            if t is None:
+                missing.append(k)
+                continue
+            arr = getattr(v, "_array", v)
+            t._set_array(jnp.asarray(np.asarray(arr)))
+        if missing:
+            import warnings
+            warnings.warn(f"set_state_dict: no program vars named "
+                          f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+        self._cache.clear()
+
     def var(self, name: str) -> Tensor:
         if name in self._feeds:
             return self._feeds[name]

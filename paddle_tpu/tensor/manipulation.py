@@ -17,7 +17,8 @@ __all__ = [
     "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
     "concat", "stack", "split", "chunk", "unstack", "unbind", "tile",
     "expand", "expand_as", "broadcast_to", "broadcast_tensors", "flip",
-    "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_nd",
+    "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_",
+    "scatter_nd",
     "scatter_nd_add", "index_select", "index_sample", "index_add",
     "index_put", "masked_select", "masked_fill", "where", "take_along_axis",
     "put_along_axis", "cast", "slice", "pad", "repeat_interleave",

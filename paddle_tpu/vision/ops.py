@@ -14,9 +14,9 @@ from ..ops.registry import register, _ensure_tensor
 
 __all__ = ["nms", "nms_padded", "multiclass_nms", "box_iou", "roi_align",
            "deform_conv2d", "box_coder", "prior_box", "yolo_box",
-           "roi_pool", "psroi_pool", "matrix_nms",
+           "yolo_loss", "roi_pool", "psroi_pool", "matrix_nms",
            "distribute_fpn_proposals", "generate_proposals",
-           "DeformConv2D"]
+           "DeformConv2D", "RoIAlign", "RoIPool", "PSRoIPool"]
 
 
 from ..ops.registry import host_only_guard as _host_only  # noqa: E402
@@ -769,3 +769,196 @@ class DeformConv2D:
                     mask=mask)
 
         return _DeformConv2D()
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: vision/ops.py yolo_loss over
+    operators/detection/yolov3_loss_op): per-sample sum of box
+    location (sigmoid-CE for x/y, L1 for w/h, scaled by 2 - gw*gh),
+    objectness (best-matching anchors positive, IoU > ignore_thresh
+    ignored) and classification (sigmoid-CE, optional label smoothing).
+
+    TPU-native: fully differentiable jnp — targets are scattered with
+    ``.at[].set(mode='drop')`` so zero-area padding boxes vanish
+    without host-side control flow, and the whole loss fuses into the
+    training step. gt_box is [N, B, 4] (cx, cy, w, h, normalized)."""
+    import jax
+
+    xs = _ensure_tensor(x)
+    gb = _ensure_tensor(gt_box)
+    gl = _ensure_tensor(gt_label)
+    gs = _ensure_tensor(gt_score) if gt_score is not None else None
+    P = len(anchor_mask)
+    A = len(anchors) // 2
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32)
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+    mask_arr = jnp.asarray(anchor_mask, jnp.int32)
+
+    def _f(xa, gbox, glab, *maybe_score):
+        N, C, H, W = xa.shape
+        assert C == P * (5 + class_num), (C, P, class_num)
+        in_w = float(downsample_ratio * W)
+        in_h = float(downsample_ratio * H)
+        xr = xa.reshape(N, P, 5 + class_num, H, W).astype(jnp.float32)
+        tx, ty = xr[:, :, 0], xr[:, :, 1]
+        tw, th = xr[:, :, 2], xr[:, :, 3]
+        tobj = xr[:, :, 4]
+        tcls = xr[:, :, 5:]  # [N, P, class, H, W]
+        gbox = gbox.astype(jnp.float32)
+        gx, gy = gbox[..., 0], gbox[..., 1]   # [N, B]
+        gw, gh = gbox[..., 2], gbox[..., 3]
+        valid = (gw > 0) & (gh > 0)
+
+        # best anchor per gt by shape IoU over ALL anchors
+        gwp = gw[..., None] * in_w   # [N, B, 1] pixels
+        ghp = gh[..., None] * in_h
+        inter = jnp.minimum(gwp, aw_all) * jnp.minimum(ghp, ah_all)
+        union = gwp * ghp + aw_all * ah_all - inter
+        shape_iou = inter / jnp.maximum(union, 1e-9)
+        best = jnp.argmax(shape_iou, axis=-1)          # [N, B]
+        # responsible slot within this head's anchor_mask (or -1)
+        in_mask = best[..., None] == mask_arr          # [N, B, P]
+        slot = jnp.where(in_mask.any(-1),
+                         jnp.argmax(in_mask, -1), -1)  # [N, B]
+        gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        ok = valid & (slot >= 0)
+        n_idx = jnp.broadcast_to(jnp.arange(N)[:, None], gi.shape)
+        flat = (((n_idx * P + slot) * H + gj) * W + gi)
+        size = N * P * H * W
+        # invalid rows get an OUT-OF-BOUNDS POSITIVE sentinel: jax
+        # normalizes negative indices (-1 -> size-1) BEFORE mode='drop'
+        # applies, which would scatter padding boxes into the last cell
+        flat = jnp.where(ok, flat, size)
+
+        bw = aw_all[best] / in_w   # best anchor size, normalized
+        bh = ah_all[best] / in_h
+        tx_t = gx * W - gi
+        ty_t = gy * H - gj
+        tw_t = jnp.log(jnp.maximum(gw / jnp.maximum(bw, 1e-9), 1e-9))
+        th_t = jnp.log(jnp.maximum(gh / jnp.maximum(bh, 1e-9), 1e-9))
+        box_scale = 2.0 - gw * gh
+        score = maybe_score[0].astype(jnp.float32) if maybe_score \
+            else jnp.ones_like(gx)
+
+        def scat(vals):
+            return jnp.zeros(size, jnp.float32).at[flat.reshape(-1)]\
+                .set(vals.reshape(-1), mode="drop")\
+                .reshape(N, P, H, W)
+
+        m_pos = scat(jnp.ones_like(gx))            # responsible cells
+        sx = scat(tx_t)
+        sy = scat(ty_t)
+        sw = scat(tw_t)
+        sh = scat(th_t)
+        sscale = scat(box_scale * score)
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        loss_xy = (bce(tx, sx) + bce(ty, sy)) * sscale * m_pos
+        loss_wh = (jnp.abs(tw - sw) + jnp.abs(th - sh)) \
+            * sscale * m_pos
+
+        # objectness: decode pred boxes, IoU vs every gt; > thresh and
+        # not responsible -> ignored
+        grid_x = jnp.arange(W).reshape(1, 1, 1, W)
+        grid_y = jnp.arange(H).reshape(1, 1, H, 1)
+        sig = jax.nn.sigmoid
+        px = (sig(tx) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_x) / W
+        py = (sig(ty) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_y) / H
+        paw = aw_all[mask_arr].reshape(1, P, 1, 1)
+        pah = ah_all[mask_arr].reshape(1, P, 1, 1)
+        pw = jnp.exp(jnp.clip(tw, -10, 10)) * paw / in_w
+        ph = jnp.exp(jnp.clip(th, -10, 10)) * pah / in_h
+
+        def box_iou_cwh(px, py, pw, ph, gx, gy, gw, gh):
+            # [N,P,H,W] pred vs [N,B] gt -> [N,B,P,H,W]
+            px, py, pw, ph = (v[:, None] for v in (px, py, pw, ph))
+            gx, gy, gw, gh = (v[..., None, None, None]
+                              for v in (gx, gy, gw, gh))
+            x1 = jnp.maximum(px - pw / 2, gx - gw / 2)
+            y1 = jnp.maximum(py - ph / 2, gy - gh / 2)
+            x2 = jnp.minimum(px + pw / 2, gx + gw / 2)
+            y2 = jnp.minimum(py + ph / 2, gy + gh / 2)
+            inter = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+            return inter / jnp.maximum(pw * ph + gw * gh - inter, 1e-9)
+
+        iou = box_iou_cwh(px, py, pw, ph, gx, gy, gw, gh)
+        iou = jnp.where(valid[..., None, None, None], iou, 0.0)
+        best_iou = iou.max(axis=1)                      # [N, P, H, W]
+        ignore = (best_iou > ignore_thresh) & (m_pos == 0)
+        obj_w = jnp.where(ignore, 0.0, 1.0)
+        sobj_score = scat(score)
+        loss_obj = bce(tobj, m_pos) * obj_w \
+            * jnp.where(m_pos > 0, sobj_score, 1.0)
+
+        # classification at responsible cells
+        pos = 1.0 - 1.0 / class_num if use_label_smooth and \
+            class_num > 1 else 1.0
+        neg = 1.0 / class_num if use_label_smooth and class_num > 1 \
+            else 0.0
+        onehot = jax.nn.one_hot(glab, class_num)        # [N, B, class]
+        y = onehot * pos + (1 - onehot) * neg
+        # ONE scatter of the whole [B, class] payload (not class_num
+        # sequential full-size scatters)
+        scls = jnp.zeros((size, class_num), jnp.float32)\
+            .at[flat.reshape(-1)].set(y.reshape(-1, class_num),
+                                      mode="drop")\
+            .reshape(N, P, H, W, class_num)
+        scls = jnp.moveaxis(scls, -1, 2)                # [N,P,class,H,W]
+        loss_cls = bce(tcls, scls) * m_pos[:, :, None] \
+            * sobj_score[:, :, None]
+
+        per_n = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                 + loss_obj.sum((1, 2, 3))
+                 + loss_cls.sum((1, 2, 3, 4)))
+        return per_n
+
+    args = (xs, gb, gl) + ((gs,) if gs is not None else ())
+    return apply_op(_f, *args, op_name="yolo_loss")
+
+
+class RoIAlign:
+    """Layer wrapper over roi_align (reference: vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         spatial_scale=self._spatial_scale,
+                         aligned=aligned)
+
+
+class RoIPool:
+    """Layer wrapper over roi_pool (reference: vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        spatial_scale=self._spatial_scale)
+
+
+class PSRoIPool:
+    """Layer wrapper over psroi_pool (reference: PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          spatial_scale=self._spatial_scale)
+
+
+# reference: vision/ops.py also exposes the image-io pair
+from .io import read_file, decode_jpeg  # noqa: E402,F401
+__all__ += ["read_file", "decode_jpeg"]

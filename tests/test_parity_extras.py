@@ -124,3 +124,74 @@ def test_top_level_version_and_run_check(capsys):
     net = __import__("paddle_tpu.nn", fromlist=["x"]).Sequential(
         __import__("paddle_tpu.nn", fromlist=["x"]).Linear(8, 4))
     assert paddle.flops(net, [1, 8]) == 64
+
+
+def test_reference_top_level_all_fully_covered():
+    """Every name in the reference's paddle/__init__.py __all__ (283
+    names) resolves on this package — a migrating user's imports work.
+    CUDA-specific names are live compat shims (paddle_tpu/compat.py)
+    mapping to this stack's devices with a warning, not dead stubs."""
+    import ast
+    import os
+
+    import pytest
+
+    ref = "/root/reference/python/paddle/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference tree not mounted")
+    names = []
+    tree = ast.parse(open(ref).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    assert len(names) > 250, "reference __all__ parse failed"
+    import paddle_tpu as paddle
+    missing = [n for n in names if not hasattr(paddle, n)]
+    assert not missing, missing
+
+
+def test_reference_submodule_alls_fully_covered():
+    """Every __all__ name of the reference's major submodules resolves
+    here too: nn, nn.functional, vision.transforms/ops, linalg, io,
+    metric, static, incubate, distributed — the surfaces a migrating
+    user's imports touch."""
+    import ast
+    import os
+
+    import pytest
+
+    BASE = "/root/reference/python/paddle"
+    if not os.path.exists(BASE):
+        pytest.skip("reference tree not mounted")
+
+    def ref_all(path):
+        tree = ast.parse(open(path).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        return [ast.literal_eval(e)
+                                for e in node.value.elts]
+        return []
+
+    import paddle_tpu as paddle
+    cases = [("nn/__init__.py", paddle.nn),
+             ("nn/functional/__init__.py", paddle.nn.functional),
+             ("vision/transforms/__init__.py", paddle.vision.transforms),
+             ("vision/ops.py", paddle.vision.ops),
+             ("linalg.py", paddle.linalg),
+             ("io/__init__.py", paddle.io),
+             ("metric/__init__.py", paddle.metric),
+             ("static/__init__.py", paddle.static),
+             ("incubate/__init__.py", paddle.incubate),
+             ("distributed/__init__.py", paddle.distributed)]
+    gaps = {}
+    for sub, mod in cases:
+        names = ref_all(os.path.join(BASE, sub))
+        assert names, f"failed to parse {sub} __all__"
+        missing = [n for n in names if not hasattr(mod, n)]
+        if missing:
+            gaps[sub] = missing
+    assert not gaps, gaps
