@@ -9,7 +9,9 @@ The device list is reordered so the pipeline (or ring-attention) axis
 SPANS the process boundary — shard_map ppermute/collective traffic must
 cross processes, which is exactly where multi-host bugs live. Each rank
 asserts the sharded step's cross-entropy matches a locally computed
-single-device reference (same cfg/seed/batch) and reports via RESULT:.
+single-device reference (same cfg/seed/batch) and reports via
+RESULT:. Variants: 1F1B pipeline hops, the ring-attention ring, and the
+dedicated ZeRO sharding axis each span the process boundary.
 """
 import json
 import os
@@ -35,7 +37,8 @@ def _boundary_spanning_devices(nprocs, per_proc):
                 .transpose(1, 0, 2).reshape(-1))
 
 
-def _run_variant(label, *, dp, pp, sp, mp, schedule, nprocs, per_proc):
+def _run_variant(label, *, dp, pp, sp, mp, schedule, nprocs,
+                 per_proc, sharding=1):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -44,7 +47,8 @@ def _run_variant(label, *, dp, pp, sp, mp, schedule, nprocs, per_proc):
     from paddle_tpu.models import llama
 
     devices = _boundary_spanning_devices(nprocs, per_proc)
-    topo = HybridTopology(dp=dp, pp=pp, sp=sp, mp=mp, devices=devices)
+    topo = HybridTopology(dp=dp, pp=pp, sp=sp, mp=mp,
+                          sharding=sharding, devices=devices)
     kw = dict(num_hidden_layers=2 * max(pp, 1),
               num_attention_heads=2 * max(mp, sp),
               num_key_value_heads=2 * max(mp, sp),
@@ -60,7 +64,7 @@ def _run_variant(label, *, dp, pp, sp, mp, schedule, nprocs, per_proc):
         schedule=schedule)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
 
-    B = max(2 * dp, (n_micro or 1) * dp)
+    B = max(2 * dp * sharding, (n_micro or 1) * dp * sharding)
     S = 16 * max(sp, 1)
     rng = np.random.default_rng(0)
     host_batch = {
@@ -123,6 +127,12 @@ def main():
     results.append(_run_variant("cp-xproc", dp=2, pp=1, sp=2, mp=2,
                                 schedule="gpipe", nprocs=nprocs,
                                 per_proc=per_proc))
+    # 3. dp2 x sharding2 x mp2: the DEDICATED ZeRO axis spans the
+    #    process boundary (param/opt-state shards live on different
+    #    hosts; the gather/scatter traffic crosses DCN in production)
+    results.append(_run_variant("zero-xproc", dp=2, pp=1, sp=1, mp=2,
+                                schedule="gpipe", nprocs=nprocs,
+                                per_proc=per_proc, sharding=2))
 
     print("RESULT:" + json.dumps({"rank": rank, "world": nprocs,
                                   "variants": results}), flush=True)

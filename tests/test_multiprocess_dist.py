@@ -94,10 +94,11 @@ def test_gang_collectives_and_dp_parity(nprocs):
 
 def test_hybrid_mesh_across_process_boundary():
     """Multi-host GSPMD: 2 processes x 4 virtual devices = one global
-    8-device mesh, with the pipeline (then the ring-attention) axis
-    spanning the process boundary. Each rank asserts CE parity against
-    its locally computed single-device reference (the worker raises on
-    mismatch); here we additionally require both ranks to agree."""
+    8-device mesh, with the pipeline, the ring-attention, and the
+    dedicated ZeRO sharding axis each spanning the process boundary.
+    Each rank asserts CE parity against its locally computed
+    single-device reference (the worker raises on mismatch); here we
+    additionally require both ranks to agree."""
     outs = _launch_gang(2, timeout=900, worker="hybrid_dist_worker.py",
                         devices_per_proc=4)
     results = []
@@ -108,7 +109,7 @@ def test_hybrid_mesh_across_process_boundary():
     assert sorted(r["rank"] for r in results) == [0, 1]
     for r in results:
         labels = [v["label"] for v in r["variants"]]
-        assert labels == ["pp-xproc", "cp-xproc"], labels
+        assert labels == ["pp-xproc", "cp-xproc", "zero-xproc"], labels
     for a, b in zip(results, results[1:]):
         for va, vb in zip(a["variants"], b["variants"]):
             assert va["ce"] == vb["ce"], (va, vb)
