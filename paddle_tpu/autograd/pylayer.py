@@ -22,10 +22,45 @@ class PyLayerContext:
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = list(tensors)
+        # capture the ACTIVE pair at save time: the reference's
+        # documented usage wraps only forward, and backward may run
+        # after the context exits — the unpack that undoes this pack
+        # must travel with the saved value, not be looked up later
+        if _SAVED_HOOKS:
+            pack, unpack = _SAVED_HOOKS[-1]
+            self._saved = [(pack(t), unpack) for t in tensors]
+        else:
+            self._saved = [(t, None) for t in tensors]
 
     def saved_tensor(self):
-        return list(self._saved)
+        return [unpack(v) if unpack is not None else v
+                for v, unpack in self._saved]
+
+
+# active (pack, unpack) hook pairs, innermost last
+_SAVED_HOOKS: List[tuple] = []
+
+
+class saved_tensors_hooks:  # noqa: N801 — reference spelling
+    """reference: autograd/saved_tensors_hooks — context manager whose
+    ``pack`` runs when a PyLayer saves a tensor for backward and whose
+    ``unpack`` runs when backward retrieves it (the CPU-offload /
+    recompute-saved-activations hook point). On this stack the jax-vjp
+    tape manages intermediate residuals itself (rematerialize with
+    paddle.distributed.recompute); the hooks apply to the EXPLICIT
+    save_for_backward channel, which is the reference's documented
+    contract surface."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self._pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        _SAVED_HOOKS.append(self._pair)
+        return self
+
+    def __exit__(self, *exc):
+        _SAVED_HOOKS.remove(self._pair)
+        return False
 
     # paddle also allows arbitrary attribute stashing — __dict__ covers it.
 

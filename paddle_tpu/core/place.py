@@ -161,3 +161,66 @@ def is_compiled_with_tpu() -> bool:
 
 def device_count() -> int:
     return jax.device_count()
+
+
+# -- vendor-compat place classes + build predicates -------------------------
+# reference: paddle.device exports every vendor's Place and an
+# is_compiled_with_* predicate; a TPU-native build answers False for
+# the others and maps foreign places to the accelerator that exists.
+
+def _mapped_vendor_place(kind, device_id=0):
+    import warnings
+    warnings.warn(
+        f"{kind}({device_id}) on a TPU-native build: mapping to the "
+        "available accelerator place", stacklevel=3)
+    return _default_place()
+
+
+class XPUPlace:
+    def __new__(cls, device_id=0):
+        return _mapped_vendor_place("XPUPlace", device_id)
+
+
+class IPUPlace:
+    def __new__(cls, device_id=0):
+        return _mapped_vendor_place("IPUPlace", device_id)
+
+
+class MLUPlace:
+    def __new__(cls, device_id=0):
+        return _mapped_vendor_place("MLUPlace", device_id)
+
+
+class NPUPlace:
+    def __new__(cls, device_id=0):
+        return _mapped_vendor_place("NPUPlace", device_id)
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def get_cudnn_version():
+    """reference: returns the cudnn version int or None when absent —
+    None here, there is no cudnn in the build."""
+    return None

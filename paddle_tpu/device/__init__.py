@@ -9,12 +9,20 @@ import jax
 
 from ..core.place import (set_device, get_device, device_count, CPUPlace,
                           TPUPlace, CustomPlace, is_compiled_with_cuda,
-                          is_compiled_with_tpu)
+                          is_compiled_with_tpu, XPUPlace, IPUPlace,
+                          MLUPlace, NPUPlace, is_compiled_with_xpu,
+                          is_compiled_with_ipu, is_compiled_with_cinn,
+                          is_compiled_with_rocm, is_compiled_with_npu,
+                          is_compiled_with_mlu, get_cudnn_version)
 
 __all__ = ["set_device", "get_device", "get_all_device_type",
            "get_available_device", "device_count", "synchronize",
            "is_compiled_with_cuda", "is_compiled_with_tpu", "cuda", "Stream",
-           "Event"]
+           "Event", "XPUPlace", "IPUPlace", "MLUPlace", "NPUPlace",
+           "is_compiled_with_xpu", "is_compiled_with_ipu",
+           "is_compiled_with_cinn", "is_compiled_with_rocm",
+           "is_compiled_with_npu", "is_compiled_with_mlu",
+           "get_cudnn_version"]
 
 
 def get_all_device_type():
@@ -112,3 +120,54 @@ class _CudaNamespace:
 
 
 cuda = _CudaNamespace()
+
+
+# -- custom-device + stream surface (reference: device/__init__.py) --------
+
+def get_all_custom_device_type():
+    """CustomDevice plugin types: the PJRT plugin fills that role here
+    (SURVEY §5.1#4), so non-CPU platforms report as custom types."""
+    return sorted({d.platform for d in jax.devices()
+                   if d.platform != "cpu"})
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform != "cpu"]
+
+
+def is_compiled_with_custom_device(device_type):
+    return device_type in get_all_custom_device_type()
+
+
+_CURRENT_STREAM = Stream()
+
+
+def current_stream(device=None):
+    """XLA orders work per device; ONE logical stream exists."""
+    return _CURRENT_STREAM
+
+
+def set_stream(stream):
+    global _CURRENT_STREAM
+    prev, _CURRENT_STREAM = _CURRENT_STREAM, stream
+    return prev
+
+
+class stream_guard:  # noqa: N801 — reference spelling
+    def __init__(self, stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+__all__ += ["get_all_custom_device_type", "get_available_custom_device",
+            "is_compiled_with_custom_device", "current_stream",
+            "set_stream", "stream_guard"]

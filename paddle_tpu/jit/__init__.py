@@ -11,8 +11,10 @@ maps onto jax's compilation cache keyed by abstract shapes/dtypes.
 `jit.save` exports StableHLO via jax.export plus a state_dict payload;
 `jit.load` restores a callable.
 """
-from .api import to_static, not_to_static, ignore_module, TracedLayer, \
-    save, load, InputSpec
+from .api import (to_static, not_to_static, ignore_module, TracedLayer,
+                  TranslatedLayer, save, load, InputSpec,
+                  enable_to_static, set_verbosity, set_code_level)
 
 __all__ = ["to_static", "not_to_static", "ignore_module", "save", "load",
-           "InputSpec", "TracedLayer"]
+           "InputSpec", "TracedLayer", "TranslatedLayer",
+           "enable_to_static", "set_verbosity", "set_code_level"]

@@ -96,6 +96,11 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         """Trace tensor/array leaves; keep every other leaf static.
 
+        With the translator disabled (jit.enable_to_static(False) —
+        the reference's ProgramTranslator().enable(False)), the
+        ORIGINAL python function runs eagerly: the debugging escape
+        hatch for stepping through un-traced code.
+
         Reference semantics: dy2static traces *tensors* into the
         program — python scalars/bools/containers are build-time values
         (a `for i in range(n)` with python n unrolls; a python bool
@@ -105,6 +110,8 @@ class StaticFunction:
         partition the (args, kwargs) pytree, jit a closure over the
         static leaves, cache per (treedef, static leaves).
         """
+        if not _TO_STATIC_ENABLED[0]:
+            return self._fn(*args, **kwargs)
         is_tensor_leaf = lambda x: isinstance(x, Tensor)  # noqa: E731
         flat, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=is_tensor_leaf)
@@ -346,3 +353,29 @@ def load(path, **configs):
     params_t = fload(path + ".pdiparams")
     params = {k: v._array for k, v in params_t.items()}
     return TranslatedLayer(exported, params)
+
+
+# -- translator global switches (reference: jit/api.py enable_to_static,
+# jit/dy2static/logging_utils set_verbosity/set_code_level) -----------------
+
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(enable_to_static_bool=True):
+    """Globally toggle @to_static: when False every StaticFunction runs
+    its ORIGINAL python body eagerly (the step-through-debugging mode of
+    the reference's ProgramTranslator().enable)."""
+    _TO_STATIC_ENABLED[0] = bool(enable_to_static_bool)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static transform logging verbosity."""
+    from . import dy2static
+    dy2static._VERBOSITY[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Print the dy2static-rewritten source of converted functions
+    (reference: set_code_level)."""
+    from . import dy2static
+    dy2static._CODE_LEVEL[0] = int(level)

@@ -71,6 +71,10 @@ def _is_traced(x) -> bool:
     return isinstance(arr, Tracer)
 
 
+# set_verbosity / set_code_level knobs (jit.api wraps these)
+_VERBOSITY = [0]
+_CODE_LEVEL = [0]
+
 _ONE_SIDED_MSG = (
     "dy2static: a variable assigned in only one branch of a "
     "tensor-predicated `if` stayed undefined in the other; assign it "
@@ -929,4 +933,8 @@ def convert_to_static(fn: Callable) -> Callable:
     out = loc[fdef.name]
     out = functools.wraps(fn)(out)
     out.__pt_dy2static__ = True
+    if _CODE_LEVEL[0] > 0 or _VERBOSITY[0] >= 3:
+        print(f"--- dy2static transformed code of "
+              f"{fn.__qualname__} ---")
+        print(ast.unparse(new_tree))
     return out

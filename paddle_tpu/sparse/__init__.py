@@ -423,3 +423,21 @@ nn = _types.SimpleNamespace(
         conv3d=_conv.conv3d, subm_conv3d=_conv.subm_conv3d,
         conv2d=_conv.conv2d, subm_conv2d=_conv.subm_conv2d,
         max_pool3d=_conv.max_pool3d, relu=relu))
+
+
+# remaining reference unary surface (zero-preserving fns operate on the
+# nonzero values only, exactly like the phi sparse kernels)
+tan = _sparse_unary("tan", jnp.tan)
+asin = _sparse_unary("asin", jnp.arcsin)
+atan = _sparse_unary("atan", jnp.arctan)
+sinh = _sparse_unary("sinh", jnp.sinh)
+asinh = _sparse_unary("asinh", jnp.arcsinh)
+atanh = _sparse_unary("atanh", jnp.arctanh)
+square = _sparse_unary("square", jnp.square)
+log1p = _sparse_unary("log1p", jnp.log1p)
+deg2rad = _sparse_unary("deg2rad", jnp.deg2rad)
+rad2deg = _sparse_unary("rad2deg", jnp.rad2deg)
+expm1 = _sparse_unary("expm1", jnp.expm1)
+
+__all__ += ["tan", "asin", "atan", "sinh", "asinh", "atanh", "square",
+            "log1p", "deg2rad", "rad2deg", "expm1"]

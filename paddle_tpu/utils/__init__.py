@@ -161,3 +161,40 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
             print(f"{name:<24}{f:>16,}")
         print(f"{'Total':<24}{total[0]:>16,}")
     return total[0]
+
+
+def require_version(min_version, max_version=None):
+    """reference: fluid/framework.py require_version — raise unless the
+    compatible-API version satisfies [min_version, max_version]. The
+    check runs against ``version.api_compatible`` (the reference API
+    generation this surface tracks), so a migrated script's
+    ``require_version("2.0")`` guard keeps working on the 0.x build."""
+    from ..version import api_compatible as __version__
+
+    def parse(v):
+        parts = []
+        for seg in str(v).split("."):
+            num = ""
+            for ch in seg:
+                if ch.isdigit():
+                    num += ch
+                else:
+                    break
+            parts.append(int(num) if num else 0)
+        return tuple((parts + [0, 0, 0, 0])[:4])
+
+    if not isinstance(min_version, str) or (
+            max_version is not None and not isinstance(max_version, str)):
+        raise TypeError("require_version takes version strings")
+    cur = parse(__version__)
+    if cur < parse(min_version):
+        raise Exception(
+            f"installed version {__version__} < required minimum "
+            f"{min_version}")
+    if max_version is not None and cur > parse(max_version):
+        raise Exception(
+            f"installed version {__version__} > required maximum "
+            f"{max_version}")
+
+
+__all__ += ["require_version"]

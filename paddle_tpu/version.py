@@ -11,6 +11,10 @@ istaged = False
 commit = "in-tree"
 with_gpu = "OFF"     # no CUDA in the build — TPU/XLA only
 xla = "ON"
+# the reference API generation this build's surface tracks (audited by
+# tests/test_parity_extras.py); require_version() compares against THIS
+# so migrated scripts' `require_version("2.0")` guards keep working
+api_compatible = "2.5.0"
 
 
 def show():

@@ -374,3 +374,189 @@ def test_ps_datasets_and_object_collectives(tmp_path):
     dist.gloo_release()
     assert dist.is_available()
     assert dist.ParallelMode.PIPELINE_PARALLEL == 2
+
+
+# ---------------------------------------------------------------------------
+# static.nn sequence family + StaticRNN; jit/autograd/device long tail
+# ---------------------------------------------------------------------------
+
+def test_sequence_ops_pair_convention():
+    import paddle_tpu.static.nn as S
+
+    vals = np.arange(10, dtype=np.float32).reshape(5, 2)
+    lens = np.array([3, 2], np.int64)
+    seq = (paddle.to_tensor(vals), paddle.to_tensor(lens))
+
+    padded, ln = S.sequence_pad(seq, 0.0)
+    assert tuple(np.asarray(padded.numpy()).shape) == (2, 3, 2)
+    assert np.asarray(padded.numpy())[1, 2].tolist() == [0, 0]  # padded
+    back = S.sequence_unpad(padded, ln)
+    np.testing.assert_array_equal(np.asarray(back[0].numpy()), vals)
+
+    sm = S.sequence_softmax((paddle.to_tensor(vals[:, :1].copy()),
+                             paddle.to_tensor(lens)))
+    s0 = np.asarray(sm[0].numpy())
+    np.testing.assert_allclose(s0[:3].sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(s0[3:].sum(), 1.0, rtol=1e-5)
+
+    np.testing.assert_array_equal(
+        np.asarray(S.sequence_pool(seq, "max").numpy()), [[4, 5], [8, 9]])
+    np.testing.assert_array_equal(
+        np.asarray(S.sequence_first_step(seq).numpy()), [[0, 1], [6, 7]])
+    np.testing.assert_array_equal(
+        np.asarray(S.sequence_last_step(seq).numpy()), [[4, 5], [8, 9]])
+    rev = S.sequence_reverse(seq)
+    np.testing.assert_array_equal(np.asarray(rev[0].numpy())[:3],
+                                  vals[:3][::-1])
+    cat = S.sequence_concat([seq, seq])
+    assert np.asarray(cat[1].numpy()).tolist() == [6, 4]
+    # expand_as: single-step items to y's lengths
+    one = (paddle.to_tensor(np.array([[1., 1.], [2., 2.]], np.float32)),
+           paddle.to_tensor(np.array([1, 1], np.int64)))
+    ex = S.sequence_expand_as(one, seq)
+    assert np.asarray(ex[1].numpy()).tolist() == [3, 2]
+    np.testing.assert_array_equal(np.asarray(ex[0].numpy())[:3],
+                                  [[1, 1]] * 3)
+    # mismatched lengths sum fails loudly
+    with pytest.raises(ValueError, match="lengths sum"):
+        S.sequence_pool((paddle.to_tensor(vals),
+                         paddle.to_tensor(np.array([9, 9]))), "max")
+
+
+def test_static_rnn_replays_block():
+    import paddle_tpu.static.nn as S
+
+    paddle.enable_static()
+    try:
+        x = np.ones((4, 2, 3), np.float32)
+        rnn = S.StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(paddle.to_tensor(x))
+            prev = rnn.memory(shape=[-1, 3],
+                              batch_ref=paddle.to_tensor(x[0]))
+            hidden = paddle.add(prev, word)
+            rnn.update_memory(prev, hidden)
+            rnn.step_output(hidden)
+        out = rnn()
+    finally:
+        paddle.disable_static()
+    a = np.asarray(out.numpy())
+    assert a.shape == (4, 2, 3)
+    np.testing.assert_allclose(a[:, 0, 0], [1, 2, 3, 4])
+
+
+def test_jit_translator_switches():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)
+        return x * 2.0
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    f(x)
+    paddle.jit.enable_to_static(False)
+    try:
+        n0 = len(calls)
+        r = f(x)
+        f(x)
+        assert len(calls) == n0 + 2  # python body every call
+        assert float(r.numpy()[0]) == 2.0
+    finally:
+        paddle.jit.enable_to_static(True)
+    assert paddle.jit.TranslatedLayer is not None
+    paddle.jit.set_verbosity(0)
+    paddle.jit.set_code_level(0)
+
+
+def test_saved_tensors_hooks_pack_unpack():
+    from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+
+    packed, unpacked = [], []
+
+    def pack(t):
+        packed.append(t)
+        return np.asarray(t.numpy())  # e.g. offload to host
+
+    def unpack(a):
+        unpacked.append(a)
+        return paddle.to_tensor(a)
+
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 2.0 * x
+
+    with saved_tensors_hooks(pack, unpack):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        y = Sq.apply(x)
+        y.backward()
+    assert packed and unpacked  # both hooks fired
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [6.0])
+    # outside the context the hooks are inactive
+    from paddle_tpu.autograd.pylayer import _SAVED_HOOKS
+    assert not _SAVED_HOOKS
+
+
+def test_device_and_sparse_long_tail():
+    assert paddle.device.is_compiled_with_rocm() is False
+    assert paddle.device.is_compiled_with_cinn() is False
+    assert paddle.device.get_cudnn_version() is None
+    paddle.utils.require_version("2.0")
+    with pytest.raises(Exception, match="minimum"):
+        paddle.utils.require_version("99.0")
+
+    s = paddle.sparse.sparse_coo_tensor(
+        paddle.to_tensor(np.array([[0, 1], [1, 0]])),
+        paddle.to_tensor(np.array([0.5, -0.25], np.float32)),
+        shape=[2, 2])
+    out = paddle.sparse.asinh(s)
+    vals = np.asarray(out._bcoo.data if hasattr(out, "_bcoo")
+                      else out.values().numpy())
+    np.testing.assert_allclose(vals, np.arcsinh([0.5, -0.25]), rtol=1e-6)
+
+
+def test_sequence_compute_ops_are_differentiable():
+    """The compute-tier sequence ops (conv/softmax/pool) must carry
+    gradients — the reference's are real ops with grad kernels; a
+    host-numpy implementation would silently freeze everything
+    upstream (the embedding) mid-model."""
+    import paddle_tpu.static.nn as S
+
+    rng = np.random.default_rng(0)
+    ln = paddle.to_tensor(np.array([3, 2], np.int64))
+
+    def grad_sum(fn):
+        x = paddle.to_tensor(
+            rng.standard_normal((5, 4)).astype(np.float32))
+        x.stop_gradient = False
+        paddle.sum(fn(x) * fn(x)).backward()
+        assert x.grad is not None
+        return float(np.abs(np.asarray(x.grad.numpy())).sum())
+
+    assert grad_sum(lambda x: S.sequence_pool((x, ln), "average")) > 0
+    assert grad_sum(lambda x: S.sequence_pool((x, ln), "max")) > 0
+    assert grad_sum(lambda x: S.sequence_softmax((x, ln))[0]) > 0
+    assert grad_sum(lambda x: S.sequence_conv((x, ln), 4, 3)[0]) > 0
+
+    # end-to-end: embedding -> conv -> pool -> classifier puts a real
+    # gradient on the embedding table
+    import paddle_tpu.nn as nn
+    emb = nn.Embedding(20, 4)
+    cls = nn.Linear(4, 3)
+    toks = paddle.to_tensor(np.array([1, 2, 3, 4, 5], np.int64))
+    conv, l2 = S.sequence_conv((emb(toks), ln), 4, 3)
+    feats = S.sequence_pool((conv, l2), "average")
+    loss = nn.CrossEntropyLoss()(cls(feats),
+                                 paddle.to_tensor(np.array([0, 1])))
+    loss.backward()
+    g = emb.parameters()[0].grad
+    assert g is not None
+    assert float(np.abs(np.asarray(g.numpy())).sum()) > 1e-4

@@ -185,8 +185,17 @@ def test_reference_submodule_alls_fully_covered():
              ("io/__init__.py", paddle.io),
              ("metric/__init__.py", paddle.metric),
              ("static/__init__.py", paddle.static),
+             ("static/nn/__init__.py", paddle.static.nn),
              ("incubate/__init__.py", paddle.incubate),
-             ("distributed/__init__.py", paddle.distributed)]
+             ("distributed/__init__.py", paddle.distributed),
+             ("device/__init__.py", paddle.device),
+             ("utils/__init__.py", paddle.utils),
+             ("jit/__init__.py", paddle.jit),
+             ("amp/__init__.py", paddle.amp),
+             ("autograd/__init__.py", paddle.autograd),
+             ("signal.py", paddle.signal),
+             ("sparse/__init__.py", paddle.sparse),
+             ("geometric/__init__.py", paddle.geometric)]
     gaps = {}
     for sub, mod in cases:
         names = ref_all(os.path.join(BASE, sub))
