@@ -1,17 +1,26 @@
 """Eager training example: LeNet on synthetic MNIST.
 
 Run: python examples/train_lenet.py  (CPU or TPU; finishes in ~1 min)
+
+Telemetry: FLAGS_tpu_metrics is switched on so the run prints a live
+metrics snapshot per epoch (optimizer step latency, dataloader wait,
+batches) plus the compile/retrace summary — see docs/observability.md.
 """
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.io import DataLoader
+from paddle_tpu.profiler import compile_tracker, metrics
 from paddle_tpu.vision.datasets import MNIST
+
+EPOCHS = 2
+STEPS_PER_EPOCH = 15
 
 
 def main():
     paddle.seed(0)
+    paddle.set_flags({"FLAGS_tpu_metrics": True})
     net = paddle.vision.models.LeNet()
     opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                 parameters=net.parameters())
@@ -19,14 +28,26 @@ def main():
     loader = DataLoader(MNIST(backend="synthetic"), batch_size=64,
                         shuffle=True)
     losses = []
-    for step, (img, label) in enumerate(loader):
-        loss = loss_fn(net(img), paddle.reshape(label, [-1]))
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        losses.append(float(loss.numpy()))
-        if step >= 30:
-            break
+    it = iter(loader)
+    for epoch in range(EPOCHS):
+        for _ in range(STEPS_PER_EPOCH):
+            img, label = next(it)
+            loss = loss_fn(net(img), paddle.reshape(label, [-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        snap = metrics.snapshot()
+        steps = snap.get("optimizer_steps_total", 0)
+        step_lat = snap.get("optimizer_step_seconds", {})
+        data_lat = snap.get("dataloader_next_seconds", {})
+        print(f"epoch {epoch}: loss {losses[-1]:.3f} | "
+              f"steps {steps:.0f} | "
+              f"step p50 {step_lat.get('p50', 0) * 1e3:.1f} ms | "
+              f"data wait p50 {data_lat.get('p50', 0) * 1e3:.1f} ms")
+    cs = compile_tracker.stats()
+    print(f"compiles: {cs['compile_count']} "
+          f"({cs['compile_seconds']:.2f} s), retraces: {cs['retraces']}")
     print(f"lenet: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
     assert losses[-1] < losses[0]
 

@@ -57,3 +57,7 @@ define_flag("FLAGS_use_autotune", True, "Enable kernel autotuning where applicab
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA manages buffers.")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "Kept for API parity; PJRT allocates.")
 define_flag("FLAGS_log_level", 0, "Framework verbose log level (VLOG analog).")
+define_flag("FLAGS_tpu_metrics", False,
+            "Enable the profiler.metrics registry (counters/gauges/"
+            "histograms on optimizer, collectives, dataloader, predictor). "
+            "Off: every recording call is a dict lookup + bool check.")

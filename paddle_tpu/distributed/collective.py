@@ -20,6 +20,7 @@ analog.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -141,6 +142,34 @@ def _in_trace(x):
 # collectives — lax under trace, identity on 1-rank eager
 # ---------------------------------------------------------------------------
 
+def _apply_collective(f, tensor, op_name):
+    """apply_op with telemetry: a host span when a profiler is live and,
+    when FLAGS_tpu_metrics is on, bytes-moved counters + a latency
+    histogram per collective op. The un-instrumented path costs one list
+    truthiness check and one dict-lookup+bool (metrics.enabled)."""
+    from ..profiler import _record_span, metrics as _metrics
+    rec = _metrics.enabled()
+    t0 = time.perf_counter() if rec else None
+    with _record_span(f"collective/{op_name}"):
+        out = apply_op(f, tensor, op_name=op_name)
+    if rec:
+        a = getattr(tensor, "_array", tensor)
+        try:
+            nbytes = int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+        except Exception:
+            nbytes = 0
+        _metrics.counter("collective_calls_total",
+                         "Collective invocations", op=op_name).inc()
+        _metrics.counter("collective_bytes_total",
+                         "Input bytes handed to collectives",
+                         op=op_name).inc(nbytes)
+        _metrics.histogram("collective_latency_seconds",
+                           "Host wall time per collective call (trace "
+                           "time under jit/shard_map)",
+                           op=op_name).observe(time.perf_counter() - t0)
+    return out
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_of(group)
 
@@ -166,7 +195,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             return jnp.where(has_zero == 1, jnp.zeros_like(mag),
                              sign * mag.astype(a.dtype))
         raise ValueError(f"unknown op {op}")
-    out = apply_op(_f, tensor, op_name="all_reduce")
+    out = _apply_collective(_f, tensor, "all_reduce")
     tensor._set_array(out._array)
     return tensor
 
@@ -182,7 +211,7 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
         if not _in_trace(a):
             return a[None] if tensor_list is not None else a
         return lax.all_gather(a, ax_name, axis=0)
-    out = apply_op(_f, tensor, op_name="all_gather")
+    out = _apply_collective(_f, tensor, "all_gather")
     if tensor_list is not None:
         n = out.shape[0]
         from ..tensor.manipulation import unstack
@@ -208,7 +237,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         # broadcast = select src's value: gather then index (XLA folds this)
         gathered = lax.all_gather(a, axis, axis=0)
         return gathered[src]
-    out = apply_op(_f, tensor, op_name="broadcast")
+    out = _apply_collective(_f, tensor, "broadcast")
     tensor._set_array(out._array)
     return tensor
 
@@ -231,7 +260,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         n = lax.axis_size(axis)
         chunk = a.shape[0] // n
         return lax.dynamic_slice_in_dim(a, idx * chunk, chunk, axis=0)
-    out = apply_op(_f, tensor, op_name="scatter")
+    out = _apply_collective(_f, tensor, "scatter")
     tensor._set_array(out._array)
     return tensor
 
@@ -257,7 +286,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
             return a
         return lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
                               tiled=False)
-    return apply_op(_f, in_tensor_list, op_name="alltoall")
+    return _apply_collective(_f, in_tensor_list, "alltoall")
 
 
 all_to_all = alltoall
@@ -275,8 +304,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         if not _in_trace(a):
             return a
         return lax.psum_scatter(a, axis, scatter_dimension=0, tiled=True)
-    out = apply_op(_f, tensor if tensor_list is None else tensor,
-                   op_name="reduce_scatter")
+    out = _apply_collective(_f, tensor, "reduce_scatter")
     return out
 
 
@@ -291,7 +319,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
         n = lax.axis_size(axis)
         perm = [(i, (i + 1) % n) for i in range(n)]
         return lax.ppermute(a, axis, perm)
-    return apply_op(_f, tensor, op_name="send")
+    return _apply_collective(_f, tensor, "send")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
@@ -328,7 +356,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
             return a
         return lax.all_to_all(a, axis, split_axis=0, concat_axis=0,
                               tiled=True)
-    out = apply_op(_f, in_tensor, op_name="alltoall_single")
+    out = _apply_collective(_f, in_tensor, "alltoall_single")
     if out_tensor is not None:
         out_tensor._set_array(out._array)
         return out_tensor

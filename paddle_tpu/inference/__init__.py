@@ -141,9 +141,14 @@ class Predictor:
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Either positional numpy inputs (returns list of numpy), or the
         handle protocol (copy_from_cpu -> run() -> copy_to_cpu)."""
+        import time
+        from ..profiler import _record_span, metrics as _metrics
+        rec = _metrics.enabled()
+        t0 = time.perf_counter() if rec else None
         if inputs is None:
             inputs = [self._inputs[n]._value for n in self._input_names]
-        outs = self._layer(*inputs)
+        with _record_span("predictor_run"):
+            outs = self._layer(*inputs)
         if not isinstance(outs, (tuple, list)):
             outs = [outs]
         arrays = [np.asarray(o._array) if isinstance(o, _EagerTensor)
@@ -153,6 +158,13 @@ class Predictor:
             h = PredictorTensor(f"output_{i}")
             h._value = a
             self._outputs[f"output_{i}"] = h
+        if rec:
+            _metrics.counter("predictor_requests_total",
+                             "Predictor.run() calls").inc()
+            _metrics.histogram(
+                "predictor_run_seconds",
+                "End-to-end Predictor.run() latency").observe(
+                    time.perf_counter() - t0)
         return arrays
 
     def clone(self):

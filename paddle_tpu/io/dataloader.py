@@ -165,9 +165,42 @@ class DataLoader:
                     "over the 'dp' axis), not by per-place feeding")
             from .device_loader import DeviceDataLoader
             buf = self.prefetch_factor if self.use_buffer_reader else 1
-            return iter(DeviceDataLoader(it, self.places[0],
-                                         buffer_size=buf))
-        return it
+            it = iter(DeviceDataLoader(it, self.places[0], buffer_size=buf))
+        return self._instrumented(it)
+
+    @staticmethod
+    def _instrumented(it):
+        """Telemetry around next-batch: a host span when a profiler is
+        live, and fetch-latency histogram + batch counter when
+        FLAGS_tpu_metrics is on. Fetch time here is consumer-side stall
+        — with prefetch ahead of the consumer it should stay near zero;
+        a hot dataloader_next_seconds histogram means input-bound."""
+        import time as _time
+        from ..profiler import _record_span, metrics as _metrics
+        try:
+            while True:
+                rec = _metrics.enabled()
+                t0 = _time.perf_counter() if rec else None
+                try:
+                    with _record_span("dataloader_next"):
+                        batch = next(it)
+                except StopIteration:
+                    return
+                if rec:
+                    _metrics.counter("dataloader_batches_total",
+                                     "Batches yielded by DataLoader").inc()
+                    _metrics.histogram(
+                        "dataloader_next_seconds",
+                        "Consumer-side wait per batch").observe(
+                            _time.perf_counter() - t0)
+                yield batch
+        finally:
+            # an early consumer break must tear down worker processes
+            # now, not at GC time (the inner generator's finally owns
+            # the worker/shm cleanup)
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
 
     def _iter_iterable(self):
         batch = []

@@ -80,6 +80,14 @@ class StaticFunction:
         self._jit_cache_cap = int(os.environ.get(
             "PADDLE_TPU_JIT_CACHE_SIZE", "128"))
         self._jit_cache_warned = False
+        # compile/retrace observability: one entry per call signature
+        # ever seen — (static key, dynamic shapes/dtypes). A second call
+        # with a new signature is a tracing-cache miss (retrace), the
+        # silent TPU perf killer the profiler's Compilation section and
+        # jit_retraces_total metric surface.
+        self._trace_sigs: set = set()
+        self._trace_name = getattr(fn, "__qualname__",
+                                   getattr(fn, "__name__", repr(fn)))
 
         def array_fn(*arrays, **kw):
             tensors = _tree_to_tensors(arrays)
@@ -132,6 +140,7 @@ class StaticFunction:
             # unhashable static leaf: no caching, direct trace each call
             key = None
         jitted = self._jit_cache.get(key) if key is not None else None
+        new_closure = jitted is None
         if jitted is not None:
             self._jit_cache.move_to_end(key)
         if jitted is None:
@@ -184,6 +193,17 @@ class StaticFunction:
                             "pass it as a Tensor, or raise "
                             "PADDLE_TPU_JIT_CACHE_SIZE.")
         dyn_arrays = [_as_array(flat[i]) for i in dyn_idx]
+        # retrace accounting: a fresh jit closure traces on its first
+        # call; an existing closure re-traces when the dynamic leaves'
+        # shapes/dtypes change. Both are tracing-cache misses.
+        sig = (key, tuple((getattr(a, "shape", ()),
+                           str(getattr(a, "dtype", "?")))
+                          for a in dyn_arrays))
+        if new_closure or sig not in self._trace_sigs:
+            if len(self._trace_sigs) < 4096:
+                self._trace_sigs.add(sig)
+            from ..profiler import compile_tracker
+            compile_tracker.record_trace(self._trace_name)
         out = jitted(*dyn_arrays)
         return _tree_to_tensors(out)
 

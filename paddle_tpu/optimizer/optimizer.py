@@ -14,6 +14,7 @@ functional fast path:
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -81,6 +82,20 @@ class Optimizer:
     # -- step --------------------------------------------------------------
     @no_grad()
     def step(self):
+        from ..profiler import _record_span, metrics as _metrics
+        rec = _metrics.enabled()
+        t0 = time.perf_counter() if rec else None
+        with _record_span("optimizer_step"):
+            self._step_impl()
+        if rec:
+            _metrics.counter("optimizer_steps_total",
+                             "Optimizer.step() calls").inc()
+            _metrics.histogram(
+                "optimizer_step_seconds",
+                "Host wall time of Optimizer.step()").observe(
+                    time.perf_counter() - t0)
+
+    def _step_impl(self):
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:

@@ -4,26 +4,42 @@ Reference analog: python/paddle/profiler/profiler.py:344 (Profiler with
 make_scheduler state machine, chrome-trace export) over the C++ HostTracer/
 CudaTracer (paddle/fluid/platform/profiler/). TPU-native: jax.profiler
 (xprof) captures device traces; RecordEvent instruments host spans into the
-same trace via jax.profiler.TraceAnnotation.
+same trace via jax.profiler.TraceAnnotation AND into a self-contained
+host-span buffer that `export_chrome_tracing` serializes as Chrome
+`trace_event` JSON — so traces work on CPU CI with no xprof attached.
+
+Telemetry siblings in this package:
+  metrics.py          — Counter/Gauge/Histogram registry (FLAGS_tpu_metrics)
+  compile_tracker.py  — jax.monitoring compile/retrace accounting
 """
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import threading
 import time
 from enum import Enum
 from typing import Callable, Optional
 
 import jax
 
+from . import metrics
+from . import compile_tracker
+
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
-           "RecordEvent", "export_chrome_tracing", "benchmark"]
+           "RecordEvent", "export_chrome_tracing", "benchmark", "metrics",
+           "compile_tracker"]
 
 # host-span aggregation for the summary stats table (reference:
 # profiler/profiler_statistic.py — EventSummary/statistic_data tables).
 # RecordEvent feeds every ACTIVE profiler's own stats dict, so
 # concurrent Profiler instances don't clobber each other.
 _ACTIVE_PROFILERS: list = []
+
+# jax.monitoring listeners live for the whole process; install once here
+# so compiles are counted even before the first Profiler is constructed.
+compile_tracker.install()
 
 
 class ProfilerTarget(Enum):
@@ -43,6 +59,18 @@ class ProfilerState(Enum):
 def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
                    skip_first: int = 0) -> Callable[[int], ProfilerState]:
     """reference: profiler.py:117 — step-indexed state machine."""
+    if closed < 0 or ready < 0 or skip_first < 0:
+        raise ValueError(
+            f"make_scheduler: closed/ready/skip_first must be >= 0, got "
+            f"closed={closed}, ready={ready}, skip_first={skip_first}")
+    if record < 1:
+        raise ValueError(
+            f"make_scheduler: record must be >= 1 (a period that never "
+            f"records profiles nothing), got record={record}")
+    if repeat < 0:
+        raise ValueError(
+            f"make_scheduler: repeat must be >= 0 (0 = repeat forever), "
+            f"got repeat={repeat}")
     period = closed + ready + record
 
     def scheduler(step: int) -> ProfilerState:
@@ -63,14 +91,23 @@ def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler that writes the profiler's host-span buffer
+    as a Chrome trace_event JSON file under `dir_name` (reference:
+    profiler.py export_chrome_tracing). Self-contained: works with no
+    xprof/TPU attached — chrome://tracing and Perfetto load the file."""
+
     def handler(prof):
         prof._log_dir = dir_name
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        prof.export(path)
     return handler
 
 
 class RecordEvent:
     """Host-span annotation visible in the xprof trace
-    (reference: paddle/fluid/platform/profiler/event_tracing.h)."""
+    (reference: paddle/fluid/platform/profiler/event_tracing.h) and
+    buffered into every RECORD-state profiler for chrome-trace export."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
@@ -87,11 +124,25 @@ class RecordEvent:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
         if self._t0 is not None and _ACTIVE_PROFILERS:
-            dt = time.perf_counter() - self._t0
+            t1 = time.perf_counter()
+            dt = t1 - self._t0
+            event = None
             for p in _ACTIVE_PROFILERS:
                 stats = p._span_stats
                 calls, total, mx = stats.get(self.name, (0, 0.0, 0.0))
                 stats[self.name] = (calls + 1, total + dt, max(mx, dt))
+                if p._state in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN) \
+                        and len(p._trace_events) < p._trace_buffer_cap:
+                    if event is None:
+                        # complete ("X") event: one dict carries the
+                        # begin/end pair; ts/dur are microseconds
+                        event = {"name": self.name, "ph": "X",
+                                 "cat": "host",
+                                 "ts": self._t0 * 1e6, "dur": dt * 1e6,
+                                 "pid": os.getpid(),
+                                 "tid": threading.get_ident()}
+                    p._trace_events.append(event)
         self._t0 = None
 
     def __enter__(self):
@@ -101,6 +152,15 @@ class RecordEvent:
     def __exit__(self, *exc):
         self.end()
         return False
+
+
+def _record_span(name: str):
+    """RecordEvent when any profiler is live, else a no-op context —
+    the zero-cost guard hot paths (optimizer/collectives/io/inference)
+    use so an un-profiled step pays one list truthiness check."""
+    if _ACTIVE_PROFILERS:
+        return RecordEvent(name)
+    return contextlib.nullcontext()
 
 
 class Profiler:
@@ -121,6 +181,9 @@ class Profiler:
         self._active = False
         self._step_times = []
         self._span_stats: dict = {}
+        self._trace_events: list = []
+        self._trace_buffer_cap = int(os.environ.get(
+            "PADDLE_TPU_TRACE_BUFFER_CAP", "1000000"))
         self._last = None
 
     def start(self):
@@ -132,6 +195,7 @@ class Profiler:
             jax.profiler.start_trace(self._log_dir)
             self._active = True
         self._span_stats.clear()
+        self._trace_events.clear()
         if self not in _ACTIVE_PROFILERS:
             _ACTIVE_PROFILERS.append(self)
         self._last = time.perf_counter()
@@ -167,18 +231,64 @@ class Profiler:
                 self._active = False
             self._state = new_state
 
+    def export(self, path: Optional[str] = None):
+        """Write the buffered host spans as a Chrome trace_event file
+        (the `{"traceEvents": [...]}` object form). Returns the path."""
+        if path is None:
+            path = os.path.join(self._log_dir,
+                                f"host_{os.getpid()}.pt.trace.json")
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        payload = {
+            "traceEvents": list(self._trace_events),
+            "displayTimeUnit": "ms",
+            "metadata": {"producer": "paddle_tpu.profiler",
+                         "steps": self._step},
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
     def step_info(self, unit=None):
         if not self._step_times:
             return ""
         import numpy as np
+        units = {"s": 1.0, "ms": 1e3, "us": 1e6, "ns": 1e9}
+        u = unit if unit in units else "ms"
+        scale = units[u]
         arr = np.asarray(self._step_times[-100:])
-        return (f"avg step: {arr.mean() * 1000:.2f} ms, "
+        return (f"avg step: {arr.mean() * scale:.2f} {u}, "
                 f"ips: {1.0 / max(arr.mean(), 1e-9):.2f} steps/s")
+
+    def _compilation_section(self) -> list:
+        """The "Compilation" block of summary_table: backend compiles,
+        cumulative compile seconds, per-function retrace attribution."""
+        st = compile_tracker.stats()
+        lines = ["Compilation",
+                 f"  backend compiles: {st['compile_count']}  "
+                 f"(cumulative {st['compile_seconds']:.3f} s)",
+                 f"  jaxpr traces: {st['trace_count']}  "
+                 f"(cumulative {st['trace_seconds']:.3f} s)"]
+        if st["persistent_cache_hits"] or st["persistent_cache_misses"]:
+            lines.append(
+                f"  persistent cache: {st['persistent_cache_hits']} hits / "
+                f"{st['persistent_cache_misses']} misses")
+        fns = st["functions"]
+        if fns:
+            lines.append(f"  traced functions: {len(fns)}, "
+                         f"retraces: {st['retraces']}")
+            worst = sorted(fns.items(), key=lambda kv: -kv[1]["traces"])[:5]
+            for name, e in worst:
+                mark = "  <-- RETRACING" if e["retraces"] else ""
+                lines.append(f"    {name[:48]:<48} {e['traces']:>4} traces "
+                             f"({e['retraces']} retraces){mark}")
+        return lines
 
     def summary_table(self, sorted_by="total", time_unit="ms") -> str:
         """Host-span stats table (reference:
         profiler_statistic.py _build_table): name / calls / total / avg /
-        max / % of wall."""
+        max / % of wall, plus the Compilation section."""
         units = {"s": 1.0, "ms": 1e3, "us": 1e6, "ns": 1e9}
         unit = units.get(time_unit, 1e3)
         if time_unit not in units:
@@ -199,8 +309,10 @@ class Profiler:
                 f"{avg * unit:>12.3f}{mx * unit:>12.3f}"
                 f"{100.0 * tot / wall:>8.1f}")
         lines.append("-" * len(header))
+        lines.extend(self._compilation_section())
+        lines.append("-" * len(header))
         if self._step_times:
-            lines.append(self.step_info())
+            lines.append(self.step_info(time_unit))
         return "\n".join(lines)
 
     def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
@@ -245,5 +357,8 @@ class benchmark:
         n = float(np.sum(self._samples)) if self._samples else 0.0
         total = float(np.sum(arr)) or 1e-12
         return {"avg_s": float(arr.mean()), "steps": len(self._times),
+                "p50_s": float(np.percentile(arr, 50)),
+                "p95_s": float(np.percentile(arr, 95)),
+                "max_s": float(arr.max()),
                 "ips": n / total,
                 "steps_per_sec": len(self._times) / total}

@@ -122,6 +122,36 @@ class TestCollectivesUnderShardMap:
                        out_specs=P("dp", None))(xs)
         np.testing.assert_allclose(np.asarray(out), np.full((8, 8), 8.0))
 
+    def test_collective_latency_histogram_populates(self):
+        """With FLAGS_tpu_metrics on, every collective records a latency
+        observation and bytes-moved counter (profiler/metrics.py) — the
+        serving-paper telemetry for spotting a slow ICI link without
+        attaching xprof."""
+        from paddle_tpu.profiler import metrics
+        metrics.reset()
+        paddle.set_flags({"FLAGS_tpu_metrics": True})
+        try:
+            topo = dist.init_mesh(dp=8)
+            from jax.experimental.shard_map import shard_map
+
+            def f(x):
+                t = paddle.Tensor(x, stop_gradient=True)
+                return dist.all_reduce(t, group=dist.Group("dp"))._array
+
+            xs = jnp.arange(8.0).reshape(8, 1)
+            shard_map(f, mesh=topo.mesh, in_specs=P("dp", None),
+                      out_specs=P("dp", None))(xs)
+            snap = metrics.snapshot()
+            hist = snap['collective_latency_seconds{op="all_reduce"}']
+            assert hist["count"] >= 1
+            assert hist["sum"] > 0
+            assert snap['collective_calls_total{op="all_reduce"}'] >= 1
+            # one [1]-float32 shard per device enters the trace: 4 bytes
+            assert snap['collective_bytes_total{op="all_reduce"}'] >= 4
+        finally:
+            paddle.set_flags({"FLAGS_tpu_metrics": False})
+            metrics.reset()
+
 
 class TestDataParallelTraining:
     def test_dp_sharded_step_matches_single(self):

@@ -18,7 +18,9 @@ def test_measure_record_check_cycle(tmp_path, monkeypatch):
     monkeypatch.setattr(op_bench, "BASELINE",
                         str(tmp_path / "baseline.json"))
     ops = "layernorm_residual,embedding_gather"
-    assert op_bench.main(["--quick", "--record", "--ops", ops]) == 0
+    metrics_out = str(tmp_path / "op_metrics.json")
+    assert op_bench.main(["--quick", "--record", "--ops", ops,
+                          "--metrics-out", metrics_out]) == 0
     with open(op_bench.BASELINE) as f:
         book = json.load(f)
     (key,) = book.keys()
@@ -26,6 +28,16 @@ def test_measure_record_check_cycle(tmp_path, monkeypatch):
     assert set(book[key]) == {"layernorm_residual", "embedding_gather",
                               "__host__"}
     assert all(v > 0 for k, v in book[key].items() if k != "__host__")
+
+    # telemetry sidecar: per-op compile attribution alongside timings
+    with open(metrics_out) as f:
+        sidecar = json.load(f)
+    assert set(sidecar["ops"]) == {"layernorm_residual",
+                                   "embedding_gather"}
+    for info in sidecar["ops"].values():
+        assert info["ms"] > 0
+        assert info["compiles"] >= 1  # fresh functions must compile
+        assert info["compile_s"] >= 0
 
     # same machine, immediately after: must pass the gate (generous
     # threshold — tiny-shape CPU timings are noisy; the gate logic is

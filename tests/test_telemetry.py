@@ -1,0 +1,371 @@
+"""Telemetry subsystem: metrics registry, self-contained chrome-trace
+export, and compile/retrace tracking.
+
+Covers the observability layer the reference stack gets from
+HostTracer + profiler_statistic tables + chrome-trace export: here a
+Prometheus-style metrics registry (profiler/metrics.py), a host-span
+trace buffer serialized as Chrome trace_event JSON with no xprof
+attached, and jax.monitoring-backed compile accounting
+(profiler/compile_tracker.py)."""
+import json
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof
+from paddle_tpu.profiler import compile_tracker, metrics
+
+
+@pytest.fixture
+def metrics_on():
+    """Enable FLAGS_tpu_metrics on a clean registry; restore after."""
+    metrics.reset()
+    paddle.set_flags({"FLAGS_tpu_metrics": True})
+    yield
+    paddle.set_flags({"FLAGS_tpu_metrics": False})
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_disabled_by_default_records_nothing(self):
+        metrics.reset()
+        assert not metrics.enabled()
+        c = metrics.counter("never_total")
+        c.inc(100)
+        h = metrics.histogram("never_seconds")
+        h.observe(1.0)
+        g = metrics.gauge("never_gauge")
+        g.set(5)
+        assert c.value == 0 and h.count == 0 and g.value == 0
+
+    def test_counter_gauge_basics(self, metrics_on):
+        c = metrics.counter("req_total", "requests")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = metrics.gauge("depth")
+        g.set(7)
+        g.dec(3)
+        assert g.value == 4
+
+    def test_get_or_create_returns_same_instance(self, metrics_on):
+        assert metrics.counter("a_total") is metrics.counter("a_total")
+        # distinct label sets are distinct series
+        assert metrics.counter("b_total", op="x") is not \
+            metrics.counter("b_total", op="y")
+        with pytest.raises(TypeError):
+            metrics.gauge("a_total")  # kind mismatch
+
+    def test_concurrent_increments(self, metrics_on):
+        c = metrics.counter("race_total")
+        h = metrics.histogram("race_seconds")
+        N, T = 1000, 8
+
+        def work():
+            for _ in range(N):
+                c.inc()
+                h.observe(0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(T)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == N * T
+        assert h.count == N * T
+
+    def test_histogram_stats_and_percentiles(self, metrics_on):
+        h = metrics.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in [0.005] * 98 + [0.5, 5.0]:
+            h.observe(v)
+        assert h.count == 100
+        assert h.max == 5.0
+        assert h.percentile(50) == 0.01  # bucket upper bound
+        assert h.percentile(99) == 1.0
+        snap = h._snapshot()
+        assert snap["count"] == 100 and snap["p50"] == 0.01
+
+    def test_snapshot_and_json(self, metrics_on):
+        metrics.counter("s_total", op="ar").inc(2)
+        metrics.gauge("s_gauge").set(1.5)
+        snap = metrics.snapshot()
+        assert snap['s_total{op="ar"}'] == 2
+        assert snap["s_gauge"] == 1.5
+        # to_json round-trips
+        assert json.loads(metrics.to_json())['s_total{op="ar"}'] == 2
+
+    def test_prometheus_text_format(self, metrics_on):
+        metrics.counter("p_total", "help text", op="ar").inc(3)
+        h = metrics.histogram("p_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        text = metrics.to_prometheus()
+        assert "# HELP p_total help text" in text
+        assert "# TYPE p_total counter" in text
+        assert 'p_total{op="ar"} 3.0' in text
+        assert "# TYPE p_seconds histogram" in text
+        assert 'p_seconds_bucket{le="0.1"} 1' in text
+        # cumulative buckets
+        assert 'p_seconds_bucket{le="1.0"} 2' in text
+        assert 'p_seconds_bucket{le="+Inf"} 2' in text
+        assert "p_seconds_count 2" in text
+
+    def test_flag_gates_recording_dynamically(self, metrics_on):
+        c = metrics.counter("gate_total")
+        c.inc()
+        paddle.set_flags({"FLAGS_tpu_metrics": False})
+        c.inc(50)
+        paddle.set_flags({"FLAGS_tpu_metrics": True})
+        c.inc()
+        assert c.value == 2
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export (self-contained, no xprof)
+# ---------------------------------------------------------------------------
+
+class TestChromeTrace:
+    def test_export_chrome_tracing_writes_valid_trace(self, tmp_path):
+        out_dir = tmp_path / "traces" / "nested"  # must be created
+        p = prof.Profiler(
+            timer_only=True,
+            on_trace_ready=prof.export_chrome_tracing(str(out_dir), "w0"))
+        p.start()
+        for _ in range(2):
+            with prof.RecordEvent("fwd"):
+                time.sleep(0.001)
+            with prof.RecordEvent("bwd"):
+                time.sleep(0.001)
+            p.step()
+        p.stop()
+        path = out_dir / "w0.pt.trace.json"
+        assert path.exists()
+        with open(path) as f:
+            data = json.load(f)
+        events = data["traceEvents"]
+        assert isinstance(events, list)
+        # complete ("X") events carry the begin/end pair in one record
+        assert len(events) == 4
+        by_name = {}
+        for e in events:
+            assert e["ph"] == "X"
+            assert e["dur"] > 0 and e["ts"] > 0
+            assert "pid" in e and "tid" in e
+            by_name.setdefault(e["name"], []).append(e)
+        assert sorted(by_name) == ["bwd", "fwd"]
+        # events must be well-ordered: fwd begins before its bwd
+        fwd0, bwd0 = by_name["fwd"][0], by_name["bwd"][0]
+        assert fwd0["ts"] + fwd0["dur"] <= bwd0["ts"] + 1e-3
+
+    def test_profiler_export_default_path(self, tmp_path):
+        p = prof.Profiler(timer_only=True)
+        p._log_dir = str(tmp_path)
+        p.start()
+        with prof.RecordEvent("x"):
+            pass
+        p.stop()
+        path = p.export()
+        with open(path) as f:
+            data = json.load(f)
+        assert [e["name"] for e in data["traceEvents"]] == ["x"]
+
+    def test_ready_state_does_not_buffer_spans(self, tmp_path):
+        # scheduler starts CLOSED->READY; spans before RECORD must not
+        # appear in the trace buffer (they still feed span stats)
+        sched = prof.make_scheduler(closed=0, ready=2, record=1)
+        p = prof.Profiler(timer_only=True, scheduler=sched)
+        p.start()  # state READY
+        with prof.RecordEvent("early"):
+            pass
+        assert p._trace_events == []
+        p.step()
+        p.step()  # now RECORD_AND_RETURN (period pos 2)
+        with prof.RecordEvent("hot"):
+            pass
+        p.stop()
+        assert [e["name"] for e in p._trace_events] == ["hot"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler validation + step_info/benchmark satellites
+# ---------------------------------------------------------------------------
+
+class TestSchedulerValidation:
+    @pytest.mark.parametrize("kwargs", [
+        dict(closed=-1, ready=0, record=1),
+        dict(closed=0, ready=-1, record=1),
+        dict(closed=0, ready=0, record=1, skip_first=-1),
+        dict(closed=0, ready=0, record=0),
+        dict(closed=1, ready=1, record=2, repeat=-1),
+    ])
+    def test_invalid_args_raise(self, kwargs):
+        with pytest.raises(ValueError):
+            prof.make_scheduler(**kwargs)
+
+    def test_valid_args_still_work(self):
+        sched = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        assert sched(0) == prof.ProfilerState.CLOSED
+        assert sched(3) == prof.ProfilerState.RECORD_AND_RETURN
+
+
+def test_step_info_honors_unit():
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    time.sleep(0.002)
+    p.step()
+    p.stop()
+    assert " ms," in p.step_info()          # default unchanged
+    info_us = p.step_info("us")
+    assert " us," in info_us
+    us = float(re.search(r"avg step: ([\d.]+) us", info_us).group(1))
+    ms = float(re.search(r"avg step: ([\d.]+) ms",
+                         p.step_info("ms")).group(1))
+    assert us == pytest.approx(ms * 1000, rel=1e-2)
+
+
+def test_benchmark_report_percentiles():
+    b = prof.benchmark()
+    b.begin()
+    for _ in range(5):
+        time.sleep(0.001)
+        b.step(num_samples=8)
+    b.end()
+    r = b.report()
+    for k in ("p50_s", "p95_s", "max_s"):
+        assert k in r and r[k] > 0
+    assert r["p50_s"] <= r["p95_s"] <= r["max_s"]
+    assert r["max_s"] >= r["avg_s"]
+
+
+# ---------------------------------------------------------------------------
+# compile / retrace tracking
+# ---------------------------------------------------------------------------
+
+class TestCompileTracking:
+    def test_monitoring_listeners_installed(self):
+        assert compile_tracker.installed()
+
+    def test_retrace_counter_on_dtype_change(self):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def poly(x):
+            return x * 2
+
+        name = [k for k in [poly._trace_name]][0]
+        before = compile_tracker.stats()["functions"].get(
+            name, {"traces": 0, "retraces": 0})
+
+        poly(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        poly(paddle.to_tensor(np.ones((2, 2), np.float32)))  # cache hit
+        mid = compile_tracker.stats()["functions"][name]
+        assert mid["traces"] == before["traces"] + 1
+
+        # dtype-changing second call is a tracing-cache miss
+        poly(paddle.to_tensor(np.ones((2, 2), np.int32)))
+        after = compile_tracker.stats()["functions"][name]
+        assert after["traces"] == before["traces"] + 2
+        assert after["retraces"] >= before["retraces"] + 1
+
+    def test_shape_change_also_retraces(self):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def f(x):
+            return x + 1
+
+        f(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        f(paddle.to_tensor(np.ones((4, 4), np.float32)))
+        st = compile_tracker.stats()["functions"][f._trace_name]
+        assert st["retraces"] >= 1
+
+    def test_backend_compile_counted_and_summary_section(self):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def g(x):
+            return x @ x
+
+        before = compile_tracker.compile_count()
+        g(paddle.to_tensor(np.eye(4, dtype=np.float32)))
+        assert compile_tracker.compile_count() > before
+        assert compile_tracker.compile_seconds() > 0
+
+        p = prof.Profiler(timer_only=True)
+        p.start()
+        p.stop()
+        table = p.summary_table()
+        assert "Compilation" in table
+        m = re.search(r"backend compiles: (\d+)", table)
+        assert m and int(m.group(1)) > 0
+        assert "cumulative" in table
+
+    def test_retraces_mirror_into_metrics(self, metrics_on):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def h(x):
+            return x - 1
+
+        h(paddle.to_tensor(np.ones((2,), np.float32)))
+        h(paddle.to_tensor(np.ones((2,), np.int32)))
+        snap = metrics.snapshot()
+        fn = h._trace_name
+        assert snap[f'jit_traces_total{{fn="{fn}"}}'] == 2
+        assert snap[f'jit_retraces_total{{fn="{fn}"}}'] == 1
+
+
+# ---------------------------------------------------------------------------
+# hot-path instrumentation
+# ---------------------------------------------------------------------------
+
+class TestHotPathInstrumentation:
+    def test_optimizer_step_metrics(self, metrics_on):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        x = paddle.ones([2, 4])
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        snap = metrics.snapshot()
+        assert snap["optimizer_steps_total"] == 1
+        assert snap["optimizer_step_seconds"]["count"] == 1
+        assert snap["optimizer_step_seconds"]["sum"] > 0
+
+    def test_dataloader_metrics(self, metrics_on):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import MNIST
+        loader = DataLoader(MNIST(backend="synthetic"), batch_size=256)
+        n = 0
+        for _batch in loader:
+            n += 1
+            if n >= 3:
+                break
+        snap = metrics.snapshot()
+        assert snap["dataloader_batches_total"] >= 3
+        assert snap["dataloader_next_seconds"]["count"] >= 3
+
+    def test_optimizer_step_span_recorded_under_profiler(self):
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        with prof.Profiler(timer_only=True) as p:
+            loss = lin(paddle.ones([2, 4])).sum()
+            loss.backward()
+            opt.step()
+        assert "optimizer_step" in p._span_stats
+        assert any(e["name"] == "optimizer_step"
+                   for e in p._trace_events)

@@ -177,6 +177,7 @@ def measure(names=None, quick=False, iters=None):
     import jax
 
     from paddle_tpu.ops.autotune import time_callable
+    from paddle_tpu.profiler import compile_tracker
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu")
@@ -184,15 +185,28 @@ def measure(names=None, quick=False, iters=None):
     names = names or list(cases)
     n_iter = iters or (2 if quick else 5)
     out = {}
+    compile_info = {}
     for name in names:
         if name not in cases:
             raise SystemExit(f"unknown op case {name!r}; "
                              f"have {sorted(cases)}")
+        # per-op compile attribution: a timing regression caused by a
+        # recompile (vs a genuinely slower kernel) shows up as a compile
+        # delta during the measured window
+        pre = compile_tracker.stats()
         fn, args = cases[name]()
         t = time_callable(fn, args, warmup=1, iters=n_iter)
+        post = compile_tracker.stats()
         out[name] = round(t * 1e3, 4)  # ms
-        print(f"{name:24s} {out[name]:10.3f} ms", flush=True)
-    return kind, out
+        compile_info[name] = {
+            "compiles": post["compile_count"] - pre["compile_count"],
+            "compile_s": round(
+                post["compile_seconds"] - pre["compile_seconds"], 4),
+        }
+        print(f"{name:24s} {out[name]:10.3f} ms   "
+              f"[{compile_info[name]['compiles']} compiles, "
+              f"{compile_info[name]['compile_s']:.2f} s]", flush=True)
+    return kind, out, compile_info
 
 
 def main(argv=None):
@@ -209,6 +223,10 @@ def main(argv=None):
                     help="with --check: a measured op with no recorded "
                          "baseline FAILS instead of being skipped, so new "
                          "ops cannot slip past the gate un-recorded")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="write a JSON telemetry sidecar (per-op compile "
+                         "count/seconds + wall ms) so a BENCH_*.json "
+                         "regression can be attributed to recompiles")
     args = ap.parse_args(argv)
 
     names = args.ops.split(",") if args.ops else None
@@ -241,8 +259,19 @@ def main(argv=None):
                 "Re-record ALL ops (drop --ops) or delete the key from "
                 f"{BASELINE} first.")
 
-    kind, results = measure(names, quick=args.quick)
+    kind, results, compile_info = measure(names, quick=args.quick)
     key = f"{kind}{'|quick' if args.quick else ''}"
+
+    if args.metrics_out:
+        sidecar = {
+            "device_kind": kind,
+            "host": host,
+            "ops": {n: {"ms": results[n], **compile_info[n]}
+                    for n in results},
+        }
+        with open(args.metrics_out, "w") as f:
+            json.dump(sidecar, f, indent=1, sort_keys=True)
+        print(f"telemetry sidecar -> {args.metrics_out}")
 
     if args.record:
         book.setdefault(key, {}).update(results)
