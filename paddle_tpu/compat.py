@@ -10,12 +10,10 @@ get_cuda_rng_state).
 """
 from __future__ import annotations
 
-import warnings
-
 import jax.numpy as jnp
 import numpy as np
 
-from .core.place import TPUPlace, _default_place
+from .core.place import _mapped_vendor_place
 from .framework.random import get_rng_state, set_rng_state
 
 __all__ = ["dtype", "batch", "tolist", "check_shape", "CUDAPlace",
@@ -28,13 +26,8 @@ __all__ = ["dtype", "batch", "tolist", "check_shape", "CUDAPlace",
 dtype = np.dtype
 
 
-def _mapped_place(kind, device_id=0):
-    warnings.warn(
-        f"{kind}({device_id}) requested on a TPU-native build: mapping "
-        "to the available accelerator place (there is no CUDA device "
-        "here; computation runs where XLA put it)", stacklevel=3)
-    p = _default_place()
-    return p if not isinstance(p, TPUPlace) else TPUPlace(device_id)
+# single vendor-place shim; core.place owns the mapping behavior
+_mapped_place = _mapped_vendor_place
 
 
 class CUDAPlace:

@@ -61,3 +61,9 @@ define_flag("FLAGS_tpu_metrics", False,
             "Enable the profiler.metrics registry (counters/gauges/"
             "histograms on optimizer, collectives, dataloader, predictor). "
             "Off: every recording call is a dict lookup + bool check.")
+define_flag("FLAGS_tpu_xmem", False,
+            "Capture per-executable memory_analysis()/cost_analysis() "
+            "(HBM peaks, temp bytes, flops) at every jit/Executor/"
+            "Predictor compile. Implied by FLAGS_tpu_metrics. New "
+            "signatures compile via the AOT path so capture never "
+            "double-compiles.")

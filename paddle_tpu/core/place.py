@@ -169,11 +169,18 @@ def device_count() -> int:
 # the others and maps foreign places to the accelerator that exists.
 
 def _mapped_vendor_place(kind, device_id=0):
+    """THE shim behind every foreign vendor place — NPU/XPU/MLU here and
+    paddle_tpu.compat's CUDA places delegate to it — so the mapping
+    behaves one way everywhere: warn, then return the place this build
+    actually computes on, preserving device_id when the accelerator
+    place carries one (the old compat.py/core.place copies diverged on
+    exactly that)."""
     import warnings
     warnings.warn(
-        f"{kind}({device_id}) on a TPU-native build: mapping to the "
-        "available accelerator place", stacklevel=3)
-    return _default_place()
+        f"{kind}({device_id}) requested on a TPU-native build: mapping "
+        "to the available accelerator place", stacklevel=3)
+    p = _default_place()
+    return TPUPlace(device_id) if isinstance(p, TPUPlace) else p
 
 
 class XPUPlace:

@@ -22,7 +22,8 @@ __all__ = ["set_device", "get_device", "get_all_device_type",
            "is_compiled_with_xpu", "is_compiled_with_ipu",
            "is_compiled_with_cinn", "is_compiled_with_rocm",
            "is_compiled_with_npu", "is_compiled_with_mlu",
-           "get_cudnn_version"]
+           "get_cudnn_version", "memory_stats", "memory_allocated",
+           "max_memory_allocated", "memory_reserved"]
 
 
 def get_all_device_type():
@@ -76,6 +77,70 @@ class Event:
         return (end._t - self._t) * 1000.0
 
 
+# -- memory introspection ---------------------------------------------------
+# Live PJRT allocator stats for the *requested* device (not always chip 0),
+# merged with xmem's analysis-derived static peaks so the numbers are
+# meaningful even on backends whose allocator doesn't track a peak (CPU
+# PJRT returns no peak_bytes_in_use; pre-flight/hardware-free runs have no
+# live allocations at all).
+
+def _resolve_jax_device(device=None) -> jax.Device:
+    """None -> current place's device; int -> ordinal into jax.devices();
+    str / Place / jax.Device via core.place parsing."""
+    from ..core.place import _current_place, _parse_device
+    if isinstance(device, jax.Device):
+        return device
+    if isinstance(device, int):
+        devs = jax.devices()
+        return devs[device % len(devs)]
+    place = _current_place() if device is None else _parse_device(device)
+    try:
+        return place.device
+    except RuntimeError:
+        return jax.devices()[0]
+
+
+def memory_stats(device=None) -> dict:
+    """PJRT allocator stats for `device` merged with compile-time analysis.
+
+    Returns the backend's native keys (bytes_in_use, bytes_reserved, ...)
+    plus:
+      live_peak_bytes_in_use   allocator-tracked peak as reported (0 if
+                               the backend doesn't track one)
+      xmem_static_peak_bytes   largest per-executable HBM peak captured by
+                               profiler.xmem (args+outputs+temps+code)
+      xmem_generated_code_bytes  total executable code size captured
+      peak_bytes_in_use        max(live peak, static peak)
+    """
+    dev = _resolve_jax_device(device)
+    try:
+        stats = dict(dev.memory_stats() or {})
+    except Exception:
+        stats = {}
+    from ..profiler import xmem
+    stats.setdefault("bytes_in_use", 0)
+    live_peak = stats.get("peak_bytes_in_use", 0)
+    static_peak = xmem.max_static_peak()
+    stats["live_peak_bytes_in_use"] = live_peak
+    stats["xmem_static_peak_bytes"] = static_peak
+    stats["xmem_generated_code_bytes"] = xmem.total_generated_code()
+    stats["peak_bytes_in_use"] = max(live_peak, static_peak)
+    return stats
+
+
+def memory_allocated(device=None) -> int:
+    return memory_stats(device).get("bytes_in_use", 0)
+
+
+def max_memory_allocated(device=None) -> int:
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return s.get("bytes_reserved", s.get("bytes_in_use", 0))
+
+
 class _CudaNamespace:
     """paddle.device.cuda / paddle.cuda parity routed to the TPU chip."""
 
@@ -101,18 +166,19 @@ class _CudaNamespace:
 
     @staticmethod
     def memory_allocated(device=None):
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("bytes_in_use", 0)
+        return memory_allocated(device)
 
     @staticmethod
     def max_memory_allocated(device=None):
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("peak_bytes_in_use", 0)
+        return max_memory_allocated(device)
 
     @staticmethod
     def memory_reserved(device=None):
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("bytes_reserved", stats.get("bytes_in_use", 0))
+        return memory_reserved(device)
+
+    @staticmethod
+    def memory_stats(device=None):
+        return memory_stats(device)
 
     @staticmethod
     def empty_cache():

@@ -80,6 +80,12 @@ class StaticFunction:
         self._jit_cache_cap = int(os.environ.get(
             "PADDLE_TPU_JIT_CACHE_SIZE", "128"))
         self._jit_cache_warned = False
+        # AOT executables per exact call signature, filled only while
+        # xmem capture is on: the signature's single compile happens via
+        # jit_fn.lower().compile() so memory/cost analysis is free, and
+        # subsequent same-signature calls dispatch straight to the
+        # Compiled (skipping even pjit's python re-dispatch)
+        self._aot_cache: "OrderedDict[Any, Any]" = OrderedDict()
         # compile/retrace observability: one entry per call signature
         # ever seen — (static key, dynamic shapes/dtypes). A second call
         # with a new signature is a tracing-cache miss (retrace), the
@@ -196,15 +202,42 @@ class StaticFunction:
         # retrace accounting: a fresh jit closure traces on its first
         # call; an existing closure re-traces when the dynamic leaves'
         # shapes/dtypes change. Both are tracing-cache misses.
-        sig = (key, tuple((getattr(a, "shape", ()),
+        shape_sig = tuple((getattr(a, "shape", ()),
                            str(getattr(a, "dtype", "?")))
-                          for a in dyn_arrays))
+                          for a in dyn_arrays)
+        sig = (key, shape_sig)
         if new_closure or sig not in self._trace_sigs:
             if len(self._trace_sigs) < 4096:
                 self._trace_sigs.add(sig)
             from ..profiler import compile_tracker
             compile_tracker.record_trace(self._trace_name)
-        out = jitted(*dyn_arrays)
+        # xmem capture: compile new signatures ahead-of-time so the ONE
+        # compile also yields memory_analysis/cost_analysis; an
+        # unhashable static leaf (key None) never caches, so it keeps
+        # the plain traced path
+        compiled = self._aot_cache.get(sig) if key is not None else None
+        if compiled is None and key is not None:
+            from ..profiler import xmem
+            if xmem.enabled():
+                compiled = xmem.aot_compile(
+                    "to_static", self._trace_name, jitted, dyn_arrays,
+                    sig=shape_sig)
+                if compiled is not None:
+                    self._aot_cache[sig] = compiled
+                    if len(self._aot_cache) > self._jit_cache_cap:
+                        self._aot_cache.popitem(last=False)
+        if compiled is not None:
+            self._aot_cache.move_to_end(sig)
+            try:
+                out = compiled(*dyn_arrays)
+            except Exception:
+                # AOT executables pin device placement/sharding, which
+                # the shape signature doesn't key on — drop the entry
+                # and let pjit handle the call
+                self._aot_cache.pop(sig, None)
+                out = jitted(*dyn_arrays)
+        else:
+            out = jitted(*dyn_arrays)
         return _tree_to_tensors(out)
 
     @property

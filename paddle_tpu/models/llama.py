@@ -41,7 +41,7 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .decoding import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "init_params", "forward_pure",
-           "build_train_step", "param_specs"]
+           "build_train_step", "param_specs", "PRESETS", "preset"]
 
 
 @dataclasses.dataclass
@@ -75,6 +75,30 @@ class LlamaConfig:
     @property
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
+
+
+# Named shapes for tools (bench presets, tools/pod_report.py). The
+# LlamaConfig defaults ARE the 7B shape, so llama7b overrides nothing.
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "llama7b": {},
+    "llama1b": dict(hidden_size=2048, intermediate_size=5504,
+                    num_hidden_layers=16, num_attention_heads=16,
+                    num_key_value_heads=16),
+    "llama-debug": dict(vocab_size=256, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=4,
+                        max_position_embeddings=256),
+}
+
+
+def preset(name: str, **overrides) -> LlamaConfig:
+    """LlamaConfig from a named preset, with field overrides on top."""
+    if name not in PRESETS:
+        raise KeyError(f"unknown llama preset {name!r}; "
+                       f"available: {sorted(PRESETS)}")
+    kw = dict(PRESETS[name])
+    kw.update(overrides)
+    return LlamaConfig(**kw)
 
 
 def _split_key(key, n):
@@ -504,6 +528,17 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
                 break
         return P(*dims)
 
+    # map each opt-state leaf to the spec of its matching param by
+    # pytree path: optax states (mu/nu/trace/...) mirror the param
+    # tree under a state-field prefix, so the param's path is a
+    # suffix of the state leaf's path. Shape-keyed matching would
+    # collide for same-shape params (wq/wo both (L,H,H)) and hand
+    # Adam moments the wrong placement.
+    flat_specs, _ = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda s: isinstance(s, P))
+    spec_by_path = [(jax.tree_util.keystr(path), s)
+                    for path, s in flat_specs]
+
     def init_fn(rng):
         with mesh:
             params = jax.jit(
@@ -519,17 +554,6 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
                 return jax.device_put(
                     x, NamedSharding(mesh, zero_shard_spec(
                         pspec, x.shape)))
-
-            # map each opt-state leaf to the spec of its matching param by
-            # pytree path: optax states (mu/nu/trace/...) mirror the param
-            # tree under a state-field prefix, so the param's path is a
-            # suffix of the state leaf's path. Shape-keyed matching would
-            # collide for same-shape params (wq/wo both (L,H,H)) and hand
-            # Adam moments the wrong placement.
-            flat_specs, _ = jax.tree_util.tree_flatten_with_path(
-                specs, is_leaf=lambda s: isinstance(s, P))
-            spec_by_path = [(jax.tree_util.keystr(path), s)
-                            for path, s in flat_specs]
 
             def place_leaf(path, x):
                 key = jax.tree_util.keystr(path)
@@ -563,6 +587,36 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     def step_fn(params, opt_state, batch):
         with mesh:
             return step_jit(params, opt_state, batch)
+
+    def abstract_state():
+        """ShapeDtypeStructs (with shardings) for (params, opt_state) —
+        lets tools (pod_report, bench) lower/compile the step and read
+        its memory_analysis() without ever materializing the weights."""
+        p_abs = jax.eval_shape(functools.partial(init_params, cfg),
+                               jax.ShapeDtypeStruct((2,), jnp.uint32))
+        p_abs = jax.tree_util.tree_map(
+            lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                               sharding=sh),
+            p_abs, param_sh)
+        o_abs = jax.eval_shape(opt.init, p_abs)
+
+        def leaf_abs(path, x):
+            shape = tuple(getattr(x, "shape", ()) or ())
+            if not shape:
+                sh = NamedSharding(mesh, P())
+            else:
+                key = jax.tree_util.keystr(path)
+                pspec = next((s for pk, s in spec_by_path
+                              if key.endswith(pk)), P())
+                sh = NamedSharding(mesh, zero_shard_spec(pspec, shape))
+            return jax.ShapeDtypeStruct(shape, x.dtype, sharding=sh)
+
+        o_abs = jax.tree_util.tree_map_with_path(leaf_abs, o_abs)
+        return p_abs, o_abs
+
+    step_fn.jitted = step_jit
+    step_fn.abstract_state = abstract_state
+    step_fn.batch_shardings = batch_sh
     return step_fn, init_fn
 
 

@@ -11,6 +11,7 @@ host-span buffer that `export_chrome_tracing` serializes as Chrome
 Telemetry siblings in this package:
   metrics.py          — Counter/Gauge/Histogram registry (FLAGS_tpu_metrics)
   compile_tracker.py  — jax.monitoring compile/retrace accounting
+  xmem.py             — per-executable memory/cost analysis capture
 """
 from __future__ import annotations
 
@@ -26,10 +27,11 @@ import jax
 
 from . import metrics
 from . import compile_tracker
+from . import xmem
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "export_chrome_tracing", "benchmark", "metrics",
-           "compile_tracker"]
+           "compile_tracker", "xmem"]
 
 # host-span aggregation for the summary stats table (reference:
 # profiler/profiler_statistic.py — EventSummary/statistic_data tables).
@@ -310,6 +312,8 @@ class Profiler:
                 f"{100.0 * tot / wall:>8.1f}")
         lines.append("-" * len(header))
         lines.extend(self._compilation_section())
+        lines.append("-" * len(header))
+        lines.extend(xmem.summary_lines())
         lines.append("-" * len(header))
         if self._step_times:
             lines.append(self.step_info(time_unit))

@@ -104,12 +104,35 @@ def scope_guard(scope):
 
 # -- legacy executor shells -------------------------------------------------
 
+_WARNED_KNOBS = set()
+
+
+def _warn_once(key, msg):
+    """One warning per swallowed-knob site per process: the legacy
+    shells accept configuration XLA now owns — silently dropping it hid
+    real tuning intent (users set BuildStrategy.fuse_* and saw nothing)."""
+    if key in _WARNED_KNOBS:
+        return
+    _WARNED_KNOBS.add(key)
+    import warnings
+    warnings.warn(msg, UserWarning, stacklevel=3)
+
+
 class _AttrBag:
     def __init__(self, **kw):
         self.__dict__.update(kw)
+        if kw:
+            self._note_swallowed(", ".join(sorted(kw)))
 
     def __setattr__(self, k, v):
         self.__dict__[k] = v
+        self._note_swallowed(k)
+
+    def _note_swallowed(self, what):
+        name = type(self).__name__
+        _warn_once(name, f"{name}.{what} is accepted for API parity "
+                   "but has no effect on this stack: XLA owns the "
+                   "fusion/scheduling decisions these knobs steered")
 
 
 class BuildStrategy(_AttrBag):
@@ -129,9 +152,17 @@ class CompiledProgram:
     def __init__(self, program, build_strategy=None):
         self._program = _default_prog(program)
         self._build_strategy = build_strategy
+        if build_strategy is not None:
+            _warn_once("CompiledProgram.build_strategy",
+                       "CompiledProgram ignores build_strategy: XLA "
+                       "makes the fusion/placement decisions here")
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
+        _warn_once("CompiledProgram.with_data_parallel",
+                   "with_data_parallel is a no-op on this stack: "
+                   "data parallelism comes from mesh axis 'dp' "
+                   "(paddle.distributed init_mesh), not executor replicas")
         return self
 
     def __getattr__(self, name):
@@ -145,6 +176,11 @@ class ParallelExecutor:
     def __init__(self, use_cuda=False, loss_name=None,
                  main_program=None, **kw):
         from . import Executor
+        if kw:
+            _warn_once("ParallelExecutor.kwargs",
+                       f"ParallelExecutor ignores {sorted(kw)}: it "
+                       "delegates to the modern Executor (one logical "
+                       "device; XLA schedules)")
         self._exe = Executor()
         self._prog = _default_prog(main_program)
 
@@ -305,9 +341,16 @@ def create_parameter(shape, dtype, name=None, attr=None,
         return helper.create_parameter(list(shape), attr=attr,
                                        is_bias=is_bias,
                                        default_initializer=default_initializer)
-    arr = _np.zeros(tuple(shape), _np.dtype(dtype)) if is_bias else \
-        _np.random.default_rng(0).standard_normal(
-            tuple(shape)).astype(_np.dtype(dtype)) * 0.02
+    if is_bias:
+        arr = _np.zeros(tuple(shape), _np.dtype(dtype))
+    else:
+        # draw from the framework RNG stream (paddle.seed controls it,
+        # each call advances it) — a fixed default_rng(0) here gave
+        # every created parameter the identical values
+        import jax as _jax
+        from ..framework.random import next_key
+        arr = (_np.asarray(_jax.random.normal(next_key(), tuple(shape)))
+               * 0.02).astype(_np.dtype(dtype))
     t = Tensor(arr)
     t.stop_gradient = False
     t.name = name
@@ -363,11 +406,22 @@ def device_guard(device=None):
 
 def exponential_decay(learning_rate, decay_steps, decay_rate,
                       staircase=False):
-    """reference: fluid layers exponential_decay — returns the modern
-    scheduler object."""
+    """reference: fluid layers exponential_decay —
+    lr(step) = learning_rate * decay_rate ** (step / decay_steps),
+    with the exponent floored when staircase. Returns the modern
+    scheduler: StepDecay IS the staircase form; the smooth form maps
+    onto ExponentialDecay through the per-step gamma
+    decay_rate ** (1 / decay_steps)."""
+    if decay_steps <= 0:
+        raise ValueError(
+            f"decay_steps must be a positive integer, got {decay_steps}")
+    if staircase:
+        from ..optimizer.lr import StepDecay
+        return StepDecay(learning_rate=learning_rate,
+                         step_size=int(decay_steps), gamma=decay_rate)
     from ..optimizer.lr import ExponentialDecay
-    gamma = decay_rate if not staircase else decay_rate
-    return ExponentialDecay(learning_rate=learning_rate, gamma=gamma)
+    return ExponentialDecay(learning_rate=learning_rate,
+                            gamma=decay_rate ** (1.0 / decay_steps))
 
 
 class WeightNormParamAttr:

@@ -72,6 +72,11 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
     pv = np.asarray(getattr(pad_value, "_array", pad_value))
     T = int(maxlen) if maxlen is not None else int(ln.max()) if len(ln) \
         else 0
+    if maxlen is not None and len(ln) and int(ln.max()) > T:
+        raise ValueError(
+            f"sequence_pad: longest sequence ({int(ln.max())}) exceeds "
+            f"maxlen ({T}); the reference op requires maxlen >= every "
+            "sequence length — it pads, it does not truncate")
     B = len(ln)
     out = np.empty((B, T) + v.shape[1:], v.dtype)
     out[...] = pv
