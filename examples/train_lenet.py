@@ -5,11 +5,14 @@ Run: python examples/train_lenet.py  (CPU or TPU; finishes in ~1 min)
 Telemetry: FLAGS_tpu_metrics is switched on so the run prints a live
 metrics snapshot per epoch (optimizer step latency, dataloader wait,
 batches) plus the compile/retrace summary — see docs/observability.md.
+Per-step scalars (loss + grad norms + the full metrics snapshot) are
+appended to runs/lenet/scalars.jsonl via hapi.callbacks.ScalarLogger.
 """
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
+from paddle_tpu.hapi.callbacks import ScalarLogger
 from paddle_tpu.io import DataLoader
 from paddle_tpu.profiler import compile_tracker, metrics
 from paddle_tpu.vision.datasets import MNIST
@@ -27,7 +30,9 @@ def main():
     loss_fn = nn.CrossEntropyLoss()
     loader = DataLoader(MNIST(backend="synthetic"), batch_size=64,
                         shuffle=True)
+    logger = ScalarLogger("runs/lenet")
     losses = []
+    step = 0
     it = iter(loader)
     for epoch in range(EPOCHS):
         for _ in range(STEPS_PER_EPOCH):
@@ -37,6 +42,8 @@ def main():
             opt.step()
             opt.clear_grad()
             losses.append(float(loss.numpy()))
+            step += 1
+            logger.log(step, loss=losses[-1])
         snap = metrics.snapshot()
         steps = snap.get("optimizer_steps_total", 0)
         step_lat = snap.get("optimizer_step_seconds", {})
@@ -45,9 +52,11 @@ def main():
               f"steps {steps:.0f} | "
               f"step p50 {step_lat.get('p50', 0) * 1e3:.1f} ms | "
               f"data wait p50 {data_lat.get('p50', 0) * 1e3:.1f} ms")
+    logger.close()
     cs = compile_tracker.stats()
     print(f"compiles: {cs['compile_count']} "
           f"({cs['compile_seconds']:.2f} s), retraces: {cs['retraces']}")
+    print(f"scalars: {logger.path}")
     print(f"lenet: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
     assert losses[-1] < losses[0]
 

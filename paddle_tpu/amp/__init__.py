@@ -21,7 +21,7 @@ from ..core.tensor import Tensor
 from ..core import dtype as dtype_mod
 
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
-           "white_list", "black_list"]
+           "white_list", "black_list", "debugging"]
 
 # O1 lists (reference: python/paddle/amp/auto_cast.py:135-149)
 WHITE_LIST = {"matmul", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
@@ -141,15 +141,27 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     return models, optimizers
 
 
+# per-optimizer unscale bookkeeping (reference: grad_scaler.py
+# OptimizerState READY/UNSCALED/STEPPED) — what prevents the canonical
+# `scaler.unscale_(opt); clip; scaler.step(opt)` pattern from dividing
+# gradients by the scale twice
+_READY, _UNSCALED, _STEPPED = "ready", "unscaled", "stepped"
+
+
 class GradScaler:
     """Dynamic loss scaling (reference: grad_scaler.py:AmpScaler). On TPU
     with bf16 this is a passthrough; with fp16 it scales and checks
-    found_inf exactly like the reference."""
+    found_inf exactly like the reference.
+
+    Telemetry (FLAGS_tpu_metrics): `amp_loss_scale` gauge plus
+    `amp_found_inf_total` / `amp_skipped_steps_total` counters, mirrored
+    into the Profiler "Numerics" section.
+    """
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
                  decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
-        self._enable = enable
+        self._enable = bool(enable)
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
@@ -159,23 +171,64 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # id(optimizer) -> _READY/_UNSCALED/_STEPPED, cleared by update()
+        self._opt_states: dict = {}
 
     def scale(self, var):
         if not self._enable:
             return var
         return var * self._scale
 
+    def _unscale_grads(self, optimizer):
+        """Divide all grads by the scale and check finiteness with ONE
+        fused reduction / host sync (the old path ran a blocking
+        `bool(jnp.any(...))` per parameter)."""
+        inv = 1.0 / self._scale
+        params = [p for p in optimizer._parameter_list
+                  if p.grad is not None]
+        if not params:
+            self._found_inf = False
+            return
+        unscaled = [p.grad._array.astype(jnp.float32) * inv
+                    for p in params]
+        finite_flags = [jnp.all(jnp.isfinite(g)) for g in unscaled]
+        all_finite = finite_flags[0]
+        for f in finite_flags[1:]:
+            all_finite = jnp.logical_and(all_finite, f)
+        found = not bool(all_finite)  # the single host sync
+        for p, g in zip(params, unscaled):
+            p.grad._set_array(g)
+        self._found_inf = found
+        if found:
+            from ..profiler import metrics as _metrics, \
+                numerics as _numerics
+            if _metrics.enabled():
+                _metrics.counter(
+                    "amp_found_inf_total",
+                    "Unscale passes that found non-finite grads").inc()
+            if _numerics.enabled():
+                _numerics.record_site(
+                    "grad_scaler.unscale", True,
+                    {"nan": -1, "inf": -1, "size": len(params),
+                     "shape": [], "dtype": "float32"})
+
     def unscale_(self, optimizer):
+        """Explicit unscale (for clipping between unscale and step).
+        Calling it twice before step()/update() raises, like the
+        reference's OptimizerState.UNSCALED guard."""
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list:
-            if p.grad is not None:
-                arr = p.grad._array.astype(jnp.float32) * inv
-                found = found or bool(jnp.any(~jnp.isfinite(arr)))
-                p.grad._set_array(arr)
-        self._found_inf = found
+        state = self._opt_states.get(id(optimizer), _READY)
+        if state == _UNSCALED:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
+        if state == _STEPPED:
+            raise RuntimeError(
+                "unscale_() is being called after step(); call update() "
+                "first")
+        self._unscale_grads(optimizer)
+        self._opt_states[id(optimizer)] = _UNSCALED
 
     def step(self, optimizer):
         # like the reference AmpScaler.step: no scale update here — the
@@ -183,16 +236,33 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        state = self._opt_states.get(id(optimizer), _READY)
+        if state == _STEPPED:
+            raise RuntimeError(
+                "step() has already been called on this optimizer since "
+                "the last update()")
+        if state != _UNSCALED:
+            # not explicitly unscaled by the caller — unscale exactly
+            # once here (the double-unscale fix)
+            self._unscale_grads(optimizer)
         if not self._found_inf:
             optimizer.step()
+        else:
+            from ..profiler import metrics as _metrics
+            if _metrics.enabled():
+                _metrics.counter(
+                    "amp_skipped_steps_total",
+                    "Optimizer steps skipped on non-finite grads").inc()
+        self._opt_states[id(optimizer)] = _STEPPED
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
         self.update()
 
     def update(self):
+        self._opt_states.clear()
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -207,6 +277,11 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        from ..profiler import metrics as _metrics, numerics as _numerics
+        if _metrics.enabled():
+            _metrics.gauge("amp_loss_scale",
+                           "Current dynamic loss scale").set(self._scale)
+        _numerics.note("loss_scale", self._scale)
 
     def is_enable(self):
         return self._enable
@@ -221,7 +296,12 @@ class GradScaler:
         self._scale = float(v)
 
     def state_dict(self):
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+        if not self._enable:
+            # reference contract: a disabled scaler round-trips as {}
+            return {"enable": False}
+        return {"enable": self._enable,
+                "use_dynamic_loss_scaling": self._dynamic,
+                "scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every,
                 "decr_every_n_nan_or_inf": self._decr_every,
@@ -229,9 +309,19 @@ class GradScaler:
                 "bad_steps": self._bad_steps}
 
     def load_state_dict(self, sd):
-        self._scale = sd.get("scale", self._scale)
-        self._good_steps = sd.get("good_steps", 0)
-        self._bad_steps = sd.get("bad_steps", 0)
+        self._enable = bool(sd.get("enable", self._enable))
+        if not self._enable:
+            return
+        self._dynamic = bool(sd.get("use_dynamic_loss_scaling",
+                                    self._dynamic))
+        self._scale = float(sd.get("scale", self._scale))
+        self._incr_ratio = sd.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = sd.get("decr_ratio", self._decr_ratio)
+        self._incr_every = sd.get("incr_every_n_steps", self._incr_every)
+        self._decr_every = sd.get("decr_every_n_nan_or_inf",
+                                  self._decr_every)
+        self._good_steps = int(sd.get("good_steps", 0))
+        self._bad_steps = int(sd.get("bad_steps", 0))
 
 
 def _norm_param_ids(model):
@@ -291,3 +381,8 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     if optimizers is None:
         return models_out
     return models_out, (opt_list[0] if single_opt else opt_list)
+
+
+# numerics debugging (paddle.amp.debugging analog): TensorCheckerConfig,
+# enable_tensor_checker, check_numerics — see docs/observability.md
+from . import debugging  # noqa: E402

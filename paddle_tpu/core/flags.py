@@ -61,6 +61,11 @@ define_flag("FLAGS_tpu_metrics", False,
             "Enable the profiler.metrics registry (counters/gauges/"
             "histograms on optimizer, collectives, dataloader, predictor). "
             "Off: every recording call is a dict lookup + bool check.")
+define_flag("FLAGS_tpu_check_nan_inf", False,
+            "Framework-wide numerics watchdog: check_numerics sites and "
+            "to_static output checks scan for NaN/Inf, with first-bad-op "
+            "localization on failure (profiler.numerics). Off: every "
+            "instrumented site is a dict lookup + bool check.")
 define_flag("FLAGS_tpu_xmem", False,
             "Capture per-executable memory_analysis()/cost_analysis() "
             "(HBM peaks, temp bytes, flops) at every jit/Executor/"

@@ -113,6 +113,70 @@ class ScalarWriter:
         self._f.close()
 
 
+class ScalarLogger(Callback):
+    """Per-step scalar + metrics-registry JSONL logger (the VisualDL
+    `LogWriter` analogue for headless runs). Each record is one JSON
+    object per line in ``<run_dir>/scalars.jsonl``:
+
+        {"step": 7, "scalars": {"loss": 1.93, ...},
+         "metrics": {...metrics.snapshot()...}}
+
+    The ``metrics`` field is included when ``FLAGS_tpu_metrics`` is on
+    (and ``with_metrics`` isn't False), so one file carries the loss
+    curve AND the numerics telemetry (grad norms, loss scale, step
+    latencies) — trivially consumed by pandas/jq or re-emitted to
+    TensorBoard. Usable two ways: as a hapi callback (Model.fit), or
+    directly from a manual loop via ``logger.log(step, loss=...)``.
+    """
+
+    def __init__(self, run_dir, log_freq=1, with_metrics=True):
+        super().__init__()
+        import os
+        self.run_dir = run_dir
+        self.log_freq = max(int(log_freq), 1)
+        self.with_metrics = with_metrics
+        os.makedirs(run_dir, exist_ok=True)
+        self.path = os.path.join(run_dir, "scalars.jsonl")
+        self._f = None
+        self._step = 0
+
+    def _file(self):
+        if self._f is None:
+            self._f = open(self.path, "a", buffering=1)
+        return self._f
+
+    def log(self, step, **scalars):
+        """Append one record; non-numeric scalars are dropped."""
+        import json
+        clean = {}
+        for k, v in scalars.items():
+            if isinstance(v, (list, tuple)) and len(v) == 1:
+                v = v[0]
+            try:
+                clean[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        record = {"step": int(step), "scalars": clean}
+        if self.with_metrics:
+            from ..profiler import metrics as _metrics
+            if _metrics.enabled():
+                record["metrics"] = _metrics.snapshot()
+        self._file().write(json.dumps(record) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % self.log_freq == 0:
+            self.log(self._step, **(logs or {}))
+
+    def on_train_end(self, logs=None):
+        self.close()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
 class VisualDL(Callback):
     """Scalar-logging callback (reference callbacks.py:772 VisualDL):
     records per-step train metrics and per-epoch eval metrics through

@@ -59,7 +59,34 @@ class Model:
             self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
         loss_vals = [float(l.item()) for l in losses]
+        self._record_train_loss(loss_vals)
         return (loss_vals, metrics) if metrics else loss_vals
+
+    @staticmethod
+    def _record_train_loss(loss_vals):
+        """Loss telemetry + tensor-checker step advance. Disabled path:
+        two dict lookups."""
+        from ..amp import debugging as _debugging
+        _debugging.advance_step()
+        from ..profiler import metrics as _metrics
+        if not _metrics.enabled():
+            return
+        import math
+        total = float(sum(loss_vals))
+        _metrics.counter("train_batches_total",
+                         "train_batch calls").inc()
+        _metrics.gauge("train_loss", "Last train_batch total loss"
+                       ).set(total)
+        from ..profiler import numerics as _numerics
+        _numerics.note("train_loss", total)
+        if not math.isfinite(total):
+            _metrics.counter("nonfinite_loss_steps_total",
+                             "train_batch steps with NaN/Inf loss").inc()
+            _numerics.record_site(
+                "hapi.train_batch:loss", True,
+                {"nan": int(math.isnan(total)),
+                 "inf": int(math.isinf(total)), "size": len(loss_vals),
+                 "shape": (len(loss_vals),), "dtype": "float32"})
 
     @no_grad()
     def eval_batch(self, inputs, labels=None):

@@ -238,7 +238,35 @@ class StaticFunction:
                 out = jitted(*dyn_arrays)
         else:
             out = jitted(*dyn_arrays)
+        # numerics watchdog (FLAGS_tpu_check_nan_inf): every to_static
+        # function is a watched function. Disabled path: dict lookup.
+        from ..profiler import numerics as _numerics
+        if _numerics.enabled():
+            self._check_numerics_out(out, args, kwargs)
         return _tree_to_tensors(out)
+
+    def _check_numerics_out(self, out, args, kwargs):
+        """Scan the call's concrete outputs for NaN/Inf; on a finding,
+        re-interpret the function's jaxpr on the SAME inputs
+        (numerics.localize) so the error names the first bad primitive
+        and its file:line — "loss went NaN" becomes "rsqrt in layer_norm
+        at llama.py:212". Fires the tensor-checker action (default
+        warn; raise/collect via amp.debugging.TensorCheckerConfig)."""
+        from ..profiler import numerics as _numerics
+        site = f"to_static:{self._trace_name}"
+        summary = _numerics._tree_summary(out)
+        _numerics.record_site(site, summary is not None, summary)
+        if summary is None:
+            return
+        from ..amp.debugging import _default_action
+        report = None
+        try:
+            report = _numerics.localize(self._converted_fn,
+                                        *args, **kwargs)
+        except Exception:  # localization must never mask the finding
+            pass
+        _numerics._dispatch(site, summary, _default_action(),
+                            report=report)
 
     @property
     def concrete_program(self):

@@ -69,6 +69,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
         global_norm = jnp.sqrt(sum(sq))
         scale = jnp.minimum(self.clip_norm
                             / jnp.maximum(global_norm, 1e-12), 1.0)
+        self._record_norms(global_norm)
         out = []
         for p, g in params_grads:
             if g is None or (hasattr(p, "need_clip") and not p.need_clip):
@@ -76,6 +77,33 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 continue
             out.append((p, Tensor((g._array * scale).astype(g._array.dtype))))
         return out
+
+    def _record_norms(self, global_norm):
+        """Numerics telemetry (FLAGS_tpu_metrics): pre/post-clip global
+        grad norms — the trajectory that shows a divergence *before* the
+        update (post-clip pins at clip_norm, pre-clip keeps climbing).
+        Disabled path: one dict lookup; traced arrays are skipped."""
+        from ..profiler import metrics as _metrics
+        if not _metrics.enabled():
+            return
+        import jax
+        if isinstance(global_norm, jax.core.Tracer):
+            return
+        pre = float(global_norm)
+        post = min(pre, self.clip_norm)
+        _metrics.gauge("grad_global_norm_preclip",
+                       "Global grad norm before ClipGradByGlobalNorm"
+                       ).set(pre)
+        _metrics.gauge("grad_global_norm_postclip",
+                       "Global grad norm after ClipGradByGlobalNorm"
+                       ).set(post)
+        if pre > self.clip_norm:
+            _metrics.counter("grad_clip_activations_total",
+                             "Steps where global-norm clipping engaged"
+                             ).inc()
+        from ..profiler import numerics as _numerics
+        _numerics.note("grad_global_norm_preclip", pre)
+        _numerics.note("grad_global_norm_postclip", post)
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

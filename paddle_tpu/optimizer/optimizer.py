@@ -27,6 +27,94 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
            "Adamax", "RMSProp", "Adadelta", "Lamb", "LarsMomentum"]
 
 
+class _StepStats:
+    """Tensor-stats telemetry for one optimizer step (FLAGS_tpu_metrics):
+    global grad norm, per-param rms / abs-max / zero-fraction, and the
+    weight-update ratio ||Δw|| / ||w|| — the numbers that make a bf16
+    divergence attributable before it becomes a NaN (ISSUE: numerics
+    observability). All accumulation is lazy jnp scalars; ONE host sync
+    happens in finish(), so the telemetry path adds a single blocking
+    transfer per step, and the disabled path adds a dict lookup."""
+
+    def __init__(self, params_grads):
+        self._gauges = []  # (metric_name, param_label, jnp scalar)
+        self._grad_sq = []
+        self._param_sq = []
+        self._upd_sq = []
+        for i, (p, g) in enumerate(params_grads):
+            if g is None:
+                continue
+            garr = g._array.astype(jnp.float32)
+            name = p.name or f"param_{i}"
+            sq = jnp.sum(garr * garr)
+            self._grad_sq.append(sq)
+            n = max(int(garr.size), 1)
+            self._gauges.append(("grad_rms", name, jnp.sqrt(sq / n)))
+            absmax = jnp.max(jnp.abs(garr)) if garr.size \
+                else jnp.asarray(0.0, jnp.float32)
+            self._gauges.append(("grad_absmax", name, absmax))
+            zf = jnp.mean((garr == 0).astype(jnp.float32)) if garr.size \
+                else jnp.asarray(0.0, jnp.float32)
+            self._gauges.append(("grad_zero_fraction", name, zf))
+
+    @staticmethod
+    def begin(params_grads):
+        """None unless metrics are on, grads exist, and we are NOT under
+        tracing (host-reading a tracer is impossible; a to_static train
+        step skips stats instead of breaking the trace)."""
+        from ..profiler import metrics as _metrics
+        if not _metrics.enabled():
+            return None
+        import jax
+        concrete = [g for _, g in params_grads if g is not None]
+        if not concrete or any(isinstance(g._array, jax.core.Tracer)
+                               for g in concrete):
+            return None
+        return _StepStats(params_grads)
+
+    def note_update(self, old32, new32):
+        self._param_sq.append(jnp.sum(old32 * old32))
+        d = new32 - old32
+        self._upd_sq.append(jnp.sum(d * d))
+
+    def finish(self):
+        from ..profiler import metrics as _metrics, numerics as _numerics
+        zero = jnp.asarray(0.0, jnp.float32)
+        grad_norm = jnp.sqrt(sum(self._grad_sq)) if self._grad_sq else zero
+        param_norm = jnp.sqrt(sum(self._param_sq)) if self._param_sq \
+            else zero
+        upd_norm = jnp.sqrt(sum(self._upd_sq)) if self._upd_sq else zero
+        scalars = [v for _, _, v in self._gauges]
+        scalars += [grad_norm, param_norm, upd_norm]
+        vals = np.asarray(jnp.stack(scalars))  # the one host sync
+        for (metric, label, _), v in zip(self._gauges, vals):
+            _metrics.gauge(metric, labels_help[metric],
+                           param=label).set(float(v))
+        gn, pn, un = (float(x) for x in vals[len(self._gauges):])
+        _metrics.gauge("grad_global_norm",
+                       "Global L2 norm of gradients at step").set(gn)
+        _metrics.gauge("param_global_norm",
+                       "Global L2 norm of parameters at step").set(pn)
+        ratio = un / pn if pn > 0 else 0.0
+        _metrics.gauge("weight_update_ratio",
+                       "||param update|| / ||param|| per step").set(ratio)
+        if not np.isfinite(gn):
+            _metrics.counter(
+                "nonfinite_grad_steps_total",
+                "Optimizer steps whose global grad norm was NaN/Inf"
+            ).inc()
+        _numerics.note("grad_global_norm", gn)
+        _numerics.note("param_global_norm", pn)
+        _numerics.note("weight_update_ratio", ratio)
+
+
+labels_help = {
+    "grad_rms": "Per-parameter RMS of the gradient",
+    "grad_absmax": "Per-parameter max |grad|",
+    "grad_zero_fraction": "Per-parameter fraction of exactly-zero grads",
+}
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -101,6 +189,7 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
+        stats = _StepStats.begin(params_grads)
         for p, g in params_grads:
             if g is None:
                 continue
@@ -125,9 +214,17 @@ class Optimizer:
                 new = self._update(p, garr, lr).astype(jnp.float32)
                 self._set_acc("master_weight", p, new)
                 p._set_array(new.astype(low_dtype))
+                if stats is not None:
+                    stats.note_update(master, new)
             else:
+                old32 = p._array.astype(jnp.float32) if stats is not None \
+                    else None
                 new = self._update(p, garr, lr)
                 p._set_array(new.astype(p._array.dtype))
+                if stats is not None:
+                    stats.note_update(old32, new.astype(jnp.float32))
+        if stats is not None:
+            stats.finish()
 
     def _use_decoupled_wd(self):
         return False

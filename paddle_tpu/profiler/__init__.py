@@ -12,6 +12,8 @@ Telemetry siblings in this package:
   metrics.py          — Counter/Gauge/Histogram registry (FLAGS_tpu_metrics)
   compile_tracker.py  — jax.monitoring compile/retrace accounting
   xmem.py             — per-executable memory/cost analysis capture
+  numerics.py         — NaN/Inf watchdog + first-bad-op localization
+                        (FLAGS_tpu_check_nan_inf)
 """
 from __future__ import annotations
 
@@ -28,10 +30,11 @@ import jax
 from . import metrics
 from . import compile_tracker
 from . import xmem
+from . import numerics
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "export_chrome_tracing", "benchmark", "metrics",
-           "compile_tracker", "xmem"]
+           "compile_tracker", "xmem", "numerics"]
 
 # host-span aggregation for the summary stats table (reference:
 # profiler/profiler_statistic.py — EventSummary/statistic_data tables).
@@ -314,6 +317,8 @@ class Profiler:
         lines.extend(self._compilation_section())
         lines.append("-" * len(header))
         lines.extend(xmem.summary_lines())
+        lines.append("-" * len(header))
+        lines.extend(numerics.summary_lines())
         lines.append("-" * len(header))
         if self._step_times:
             lines.append(self.step_info(time_unit))

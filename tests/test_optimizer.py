@@ -101,6 +101,31 @@ def test_optimizer_state_dict_roundtrip():
         opt._accumulators["moment1"][id(w)])
 
 
+def test_grad_scaler_state_dict_roundtrip():
+    from paddle_tpu.amp import GradScaler
+    s = GradScaler(init_loss_scaling=512.0, incr_ratio=4.0,
+                   decr_ratio=0.25, incr_every_n_steps=7,
+                   decr_every_n_nan_or_inf=3)
+    s._good_steps = 5
+    s._bad_steps = 1
+    sd = s.state_dict()
+    s2 = GradScaler(init_loss_scaling=1.0)
+    s2.load_state_dict(sd)
+    assert s2.get_init_loss_scaling() == 512.0
+    assert s2._incr_ratio == 4.0 and s2._decr_ratio == 0.25
+    assert s2._incr_every == 7 and s2._decr_every == 3
+    assert s2._good_steps == 5 and s2._bad_steps == 1
+    assert s2.is_use_dynamic_loss_scaling()
+    assert s2.state_dict() == sd
+
+    # a disabled scaler round-trips as disabled
+    off = GradScaler(enable=False)
+    assert off.state_dict() == {"enable": False}
+    s3 = GradScaler()
+    s3.load_state_dict(off.state_dict())
+    assert not s3.is_enable()
+
+
 def test_lr_scheduler_integration():
     w, x, target = quad_problem()
     sched = lr_mod.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)

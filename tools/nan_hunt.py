@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""nan_hunt: offline first-bad-op localization for a saved repro.
+
+Takes a pickled repro payload (typically dumped from a failing run),
+re-runs the function under ``profiler.numerics.localize`` — which
+re-interprets the jaxpr equation by equation — and reports the FIRST
+primitive whose output goes non-finite while its inputs were still
+finite, with the user source file:line that emitted it.
+
+    python tools/nan_hunt.py --repro failing_step.pkl
+    python tools/nan_hunt.py --repro failing_step.pkl --out report.json
+
+The payload is a dict with:
+
+    fn      dotted import path "pkg.module:callable" of the function
+            to hunt, OR
+    src     python source text defining it, with
+    entry   the callable's name inside ``src``
+    args    list of arrays / array-likes (positional inputs)
+    kwargs  optional dict of keyword inputs
+
+Exit status: 0 = everything finite, 2 = non-finite found (JSON report
+on stdout / --out), 1 = bad payload or usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import pickle
+import sys
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repro", required=True,
+                    help="pickled payload: {fn|src+entry, args, kwargs}")
+    ap.add_argument("--out", default="-",
+                    help="output path for the JSON report (- = stdout)")
+    return ap.parse_args(argv)
+
+
+def _load_fn(payload):
+    if "fn" in payload:
+        spec = payload["fn"]
+        if ":" in spec:
+            mod_name, attr = spec.split(":", 1)
+        else:
+            mod_name, attr = spec.rsplit(".", 1)
+        fn = importlib.import_module(mod_name)
+        for part in attr.split("."):
+            fn = getattr(fn, part)
+        return fn
+    if "src" in payload:
+        entry = payload.get("entry")
+        if not entry:
+            raise SystemExit("payload with 'src' must also name 'entry'")
+        ns: dict = {}
+        exec(compile(payload["src"], "<nan_hunt repro>", "exec"), ns)
+        if entry not in ns:
+            raise SystemExit(f"entry {entry!r} not defined by payload src")
+        return ns[entry]
+    raise SystemExit("payload must carry 'fn' (import path) or "
+                     "'src' + 'entry'")
+
+
+def main(argv=None):
+    ns = _parse_args(argv)
+    try:
+        with open(ns.repro, "rb") as f:
+            payload = pickle.load(f)
+    except (OSError, pickle.UnpicklingError) as e:
+        raise SystemExit(f"cannot load repro {ns.repro!r}: {e}")
+    if not isinstance(payload, dict):
+        raise SystemExit("repro payload must be a dict")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    fn = _load_fn(payload)
+    args = payload.get("args", [])
+    kwargs = payload.get("kwargs", {}) or {}
+
+    from paddle_tpu.profiler import numerics
+
+    report = numerics.localize(fn, *args, **kwargs)
+    doc = {"repro": ns.repro, "finite": report is None, "report": report}
+    text = json.dumps(doc, indent=2, default=str)
+    if ns.out == "-":
+        print(text)
+    else:
+        with open(ns.out, "w") as f:
+            f.write(text + "\n")
+        print(f"report written to {ns.out}")
+    if report is not None:
+        where = report.get("where") or "?"
+        print(f"FIRST BAD OP: {report.get('primitive')} at {where}",
+              file=sys.stderr)
+        return 2
+    print("all outputs finite", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
