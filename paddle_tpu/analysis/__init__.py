@@ -1,0 +1,30 @@
+"""Static-analysis suite (`tpu_lint`): jaxpr + AST hazard checks.
+
+Level 1 (``jaxpr_checks``) lints any traceable function *without
+executing it* — hidden host callbacks in loop bodies, silent f64
+promotion, int32-overflow reductions, oversized baked-in constants,
+unusable donations, and collective divergence across cond branches.
+Run it at trace time via ``to_static(..., lint=True)`` or globally via
+``FLAGS_tpu_lint``; findings surface in the Profiler "Lint" section and
+as ``lint_findings_total`` metrics.
+
+Level 2 (``ast_checks``) lints Python source — the ``tools/tpu_lint.py``
+CLI runs it over the framework itself (self-hosting, with a checked-in
+baseline at ``tools/tpu_lint_baseline.json``).
+
+See docs/static_analysis.md for the rule catalogue and pragma syntax.
+"""
+from . import core
+from . import ast_checks
+from . import jaxpr_checks
+from .core import (ERROR, WARNING, Finding, enabled, findings, record,
+                   reset, summary_lines)
+from .ast_checks import AST_RULES, check_file, check_paths, check_source
+from .jaxpr_checks import (DEFAULT_CONFIG, JAXPR_RULES, check_jaxpr,
+                           lint_callable, lint_traced)
+
+__all__ = ["core", "ast_checks", "jaxpr_checks", "Finding", "ERROR",
+           "WARNING", "enabled", "findings", "record", "reset",
+           "summary_lines", "AST_RULES", "JAXPR_RULES", "DEFAULT_CONFIG",
+           "check_file", "check_paths", "check_source", "check_jaxpr",
+           "lint_callable", "lint_traced"]

@@ -1,0 +1,290 @@
+"""Level-2 lint: Python AST rules for framework and user code.
+
+These catch the hazards that live *outside* any jaxpr — the host-side
+patterns that made PR 1-3 slow or silent:
+
+============================  =========  ====================================
+rule                          severity   hazard
+============================  =========  ====================================
+host-sync-in-loop             error      float()/bool()/int() over a
+                                         jnp/jax expression, or
+                                         .item()/.numpy()/.tolist(), inside
+                                         a loop or a to_static body — a
+                                         blocking device→host transfer per
+                                         iteration (the _unscale_grads bug)
+except-pass                   warning    `except Exception: pass` (or bare
+                                         except) silently swallowing —
+                                         narrow it, log it, or pragma it
+mutable-default-arg           warning    list/dict/set literal as a default
+                                         — shared across calls
+flag-lookup-in-loop           warning    get_flags()/flags.flag()/
+                                         os.environ lookups inside a loop —
+                                         hoist the read out of the hot path
+============================  =========  ====================================
+
+The sanctioned host-transfer idiom is an *explicit* ``jax.device_get``
+of a batched stats array (one transfer per step): expressions containing
+``device_get`` / ``block_until_ready`` are deliberately not flagged.
+
+Suppression: ``# tpu-lint: disable=<rule>[,<rule>]`` (or ``=all``) on
+the flagged line or the line above.
+
+Stdlib-only on purpose: ``tools/tpu_lint.py`` runs these rules without
+importing jax (or paddle_tpu).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .core import ERROR, WARNING, Finding, filter_pragmas
+
+__all__ = ["AST_RULES", "check_source", "check_file", "check_paths",
+           "iter_py_files"]
+
+# rule id -> (severity, one-line doc) — the catalogue the CLI and docs use
+AST_RULES: Dict[str, tuple] = {
+    "host-sync-in-loop": (
+        ERROR, "implicit blocking device->host transfer inside a loop or "
+               "to_static body"),
+    "except-pass": (
+        WARNING, "except Exception/bare except whose body only passes"),
+    "mutable-default-arg": (
+        WARNING, "mutable default argument shared across calls"),
+    "flag-lookup-in-loop": (
+        WARNING, "flag/env lookup inside a loop body"),
+}
+
+_SYNC_ATTRS = {"item", "numpy", "tolist"}
+_SYNC_WRAPPERS = {"float", "bool", "int"}
+_TRACED_ROOTS = {"jnp", "jax", "lax"}
+_EXPLICIT_TRANSFER = {"device_get", "block_until_ready"}
+_TO_STATIC_NAMES = {"to_static"}
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of a dotted expression (jnp.linalg.norm -> jnp)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _func_attr(node: ast.AST) -> Optional[str]:
+    """Final attribute/name of a call target (jax.device_get -> device_get)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _contains_traced_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                _root_name(sub.func) in _TRACED_ROOTS:
+            return True
+    return False
+
+
+def _contains_explicit_transfer(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                _func_attr(sub.func) in _EXPLICIT_TRANSFER:
+            return True
+    return False
+
+
+def _is_to_static_decorated(node) -> bool:
+    for dec in getattr(node, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if _func_attr(target) in _TO_STATIC_NAMES:
+            return True
+    return False
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in {"list", "dict", "set"}
+            and not node.args and not node.keywords)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    typ = handler.type
+    broad = (typ is None
+             or (isinstance(typ, ast.Name)
+                 and typ.id in {"Exception", "BaseException"})
+             or (isinstance(typ, ast.Attribute)
+                 and typ.attr in {"Exception", "BaseException"}))
+    if not broad:
+        return False
+    body = handler.body
+    if all(isinstance(s, ast.Pass) for s in body):
+        return True
+    return (len(body) == 1 and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and body[0].value.value is Ellipsis)
+
+
+def _is_flag_lookup(call: ast.Call) -> bool:
+    fn = call.func
+    attr = _func_attr(fn)
+    if attr in {"get_flags", "getenv"}:
+        return True
+    if attr == "flag" and isinstance(fn, ast.Attribute):
+        return True  # flags.flag(...) / _flags.flag(...)
+    # os.environ.get(...)
+    if attr == "get" and isinstance(fn, ast.Attribute) and \
+            isinstance(fn.value, ast.Attribute) and \
+            fn.value.attr == "environ":
+        return True
+    return False
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self._loop_depth = 0
+        self._traced_depth = 0  # inside a to_static-decorated function
+
+    def _add(self, rule: str, line: int, message: str, **extra):
+        severity = AST_RULES[rule][0]
+        self.findings.append(Finding(
+            rule=rule, severity=severity, message=message, file=self.path,
+            line=line, source="ast", extra=extra))
+
+    # -- context tracking ---------------------------------------------------
+    def visit_For(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = visit_For
+    visit_AsyncFor = visit_For
+
+    def _visit_function(self, node):
+        self._check_defaults(node)
+        traced = _is_to_static_decorated(node)
+        # a nested def is a new host frame: loops outside it don't make
+        # its body per-iteration code
+        outer_loop, self._loop_depth = self._loop_depth, 0
+        self._traced_depth += 1 if traced else 0
+        self.generic_visit(node)
+        self._traced_depth -= 1 if traced else 0
+        self._loop_depth = outer_loop
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def _check_defaults(self, node):
+        args = node.args
+        for default in list(args.defaults) + \
+                [d for d in args.kw_defaults if d is not None]:
+            if _is_mutable_default(default):
+                self._add("mutable-default-arg", default.lineno,
+                          f"mutable default argument in "
+                          f"{node.name}() — shared across calls; "
+                          "default to None and create inside")
+
+    # -- rules --------------------------------------------------------------
+    def visit_ExceptHandler(self, node):
+        if _swallows(node):
+            self._add("except-pass", node.lineno,
+                      "broad except silently swallows all errors — narrow "
+                      "to the expected exception, log at debug level, or "
+                      "annotate with `# tpu-lint: disable=except-pass` "
+                      "if genuinely best-effort")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        hot = self._loop_depth > 0 or self._traced_depth > 0
+        where = ("loop body" if self._loop_depth > 0
+                 else "to_static-traced body")
+        attr = _func_attr(node.func)
+        if hot and attr in _SYNC_ATTRS and not node.args \
+                and isinstance(node.func, ast.Attribute):
+            self._add("host-sync-in-loop", node.lineno,
+                      f".{attr}() inside a {where} blocks on a "
+                      "device->host transfer every iteration — batch the "
+                      "scalars device-side and jax.device_get() once")
+        elif hot and isinstance(node.func, ast.Name) \
+                and node.func.id in _SYNC_WRAPPERS and len(node.args) == 1 \
+                and _contains_traced_call(node.args[0]) \
+                and not _contains_explicit_transfer(node.args[0]):
+            self._add("host-sync-in-loop", node.lineno,
+                      f"{node.func.id}(<jax expression>) inside a {where} "
+                      "forces a blocking device->host sync every "
+                      "iteration — fuse the scalars into one device "
+                      "computation and jax.device_get() once")
+        if self._loop_depth > 0 and _is_flag_lookup(node):
+            self._add("flag-lookup-in-loop", node.lineno,
+                      "flag/env lookup inside a loop — read it once "
+                      "before the loop (per-step dict/env lookups add up "
+                      "in hot paths)")
+        self.generic_visit(node)
+
+
+def check_source(source: str, path: str = "<string>",
+                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source text. Returns pragma-filtered findings sorted by
+    line. Syntax errors surface as a single ``syntax-error`` finding."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rule="syntax-error", severity=WARNING,
+                        message=f"could not parse: {e.msg}", file=path,
+                        line=e.lineno or 0, source="ast")]
+    checker = _Checker(path)
+    checker.visit(tree)
+    found = checker.findings
+    if rules is not None:
+        allowed = set(rules)
+        found = [f for f in found if f.rule in allowed]
+    found = filter_pragmas(found, source.splitlines())
+    found.sort(key=lambda f: (f.line or 0, f.rule))
+    return found
+
+
+def check_file(path: str,
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    try:
+        with open(path, "r", errors="replace") as f:
+            source = f.read()
+    except OSError as e:
+        return [Finding(rule="io-error", severity=WARNING,
+                        message=str(e), file=path, line=0, source="ast")]
+    return check_source(source, path=path, rules=rules)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".tox", ".venv", "node_modules",
+              "build", "dist"}
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS
+                                     and not d.startswith("."))
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def check_paths(paths: Sequence[str],
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every .py file under ``paths`` (deterministic order)."""
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(check_file(path, rules=rules))
+    findings.sort(key=lambda f: (f.file or "", f.line or 0, f.rule))
+    return findings

@@ -1,0 +1,361 @@
+"""Level-1 lint: jaxpr analyzers.
+
+Each rule walks a traced function's jaxpr (``core.walk_eqns`` — the same
+eqn-by-eqn recursion as ``profiler/numerics.localize``, minus the
+evaluation) and reports hazards the compiler or runtime would only
+surface as slowness, wrong numbers, or a deadlock:
+
+============================  =========  ====================================
+rule                          severity   hazard
+============================  =========  ====================================
+host-callback-in-loop         error      pure/io/debug callback inside a
+                                         scan/while body — a hidden host
+                                         round-trip every iteration
+f64-promotion                 warning    an op silently promotes to
+                                         float64/complex128 (x64 mode) —
+                                         2x memory + off the TPU fast path
+int32-overflow-reduction      warning    sum/cumsum/dot over a large int32
+                                         (or narrower) operand accumulates
+                                         in int32 — overflow-prone
+oversized-constant            warning    big array captured as a baked-in
+                                         constant instead of an argument —
+                                         bloats every executable + recompiles
+                                         on change
+unusable-donation             warning    donated buffer matches no output
+                                         shape/dtype — donation silently lost
+collective-divergence         error      cond branches issue different
+                                         collective sequences — a deadlock
+                                         precursor across the mesh
+============================  =========  ====================================
+
+All jax imports are lazy so ``tools/tpu_lint.py`` can load this package
+without paying (or having) the jax import.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+from .core import (ERROR, WARNING, Finding, eqn_site, filter_file_pragmas,
+                   sub_closed_jaxprs, walk_eqns)
+
+__all__ = ["JAXPR_RULES", "DEFAULT_CONFIG", "check_jaxpr", "lint_callable",
+           "lint_traced"]
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    # consts >= this many bytes should be arguments, not literals
+    "max_const_bytes": 1 << 20,
+    # reductions over >= this many int32 elements are overflow-prone
+    "int_reduce_elems": 1 << 20,
+}
+
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "host_callback_call", "outside_call", "callback",
+                   "python_callback"}
+
+_COLLECTIVE_PRIMS = {"psum", "pmax", "pmin", "ppermute", "pbroadcast",
+                     "all_gather", "all_to_all", "reduce_scatter",
+                     "psum_scatter", "pgather"}
+
+_WIDE_DTYPES = ("float64", "complex128")
+
+# rule id -> (severity, check fn, one-line doc).  Checks take
+# (closed_jaxpr, config, name) and return a list of Findings.
+JAXPR_RULES: Dict[str, tuple] = {}
+
+
+def _jaxpr_rule(rule_id: str, severity: str, doc: str):
+    def deco(fn):
+        JAXPR_RULES[rule_id] = (severity, fn, doc)
+        return fn
+    return deco
+
+
+def _aval(v):
+    return getattr(v, "aval", None)
+
+
+def _dtype_name(v) -> str:
+    a = _aval(v)
+    return str(getattr(a, "dtype", ""))
+
+
+def _finding(rule: str, severity: str, msg: str, eqn=None, name=None,
+             **extra) -> Finding:
+    file, line, where = eqn_site(eqn) if eqn is not None else (None, None,
+                                                              "<jaxpr>")
+    extra.setdefault("where", where)
+    return Finding(rule=rule, severity=severity, message=msg, file=file,
+                   line=line, function=name, source="jaxpr", extra=extra)
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+@_jaxpr_rule("host-callback-in-loop", ERROR,
+             "host/debug callback inside a scan or while body")
+def _check_host_callbacks(closed, cfg, name) -> List[Finding]:
+    out = []
+    for eqn, path, in_loop in walk_eqns(closed.jaxpr):
+        if in_loop and eqn.primitive.name in _CALLBACK_PRIMS:
+            out.append(_finding(
+                "host-callback-in-loop", ERROR,
+                f"{eqn.primitive.name} inside a device loop body "
+                f"({path}): the host is called back every iteration — "
+                "a hidden sync point; hoist it out of the loop or batch "
+                "results and transfer once",
+                eqn=eqn, name=name, path=path))
+    return out
+
+
+@_jaxpr_rule("f64-promotion", WARNING,
+             "silent promotion to float64/complex128")
+def _check_f64_promotion(closed, cfg, name) -> List[Finding]:
+    out = []
+    for eqn, path, _ in walk_eqns(closed.jaxpr):
+        if sub_closed_jaxprs(eqn):
+            continue  # blame the leaf primitive inside, not the wrapper
+        wide_out = [v for v in eqn.outvars
+                    if _dtype_name(v) in _WIDE_DTYPES]
+        if not wide_out:
+            continue
+        if any(_dtype_name(v) in _WIDE_DTYPES for v in eqn.invars):
+            continue  # propagation, not introduction
+        weak = any(getattr(_aval(v), "weak_type", False)
+                   for v in eqn.invars)
+        hint = ("a weakly-typed python scalar widened the result; "
+                "wrap the scalar in jnp.asarray(..., dtype=...)" if weak
+                else "add an explicit dtype or cast the operand")
+        out.append(_finding(
+            "f64-promotion", WARNING,
+            f"{eqn.primitive.name} produces {_dtype_name(wide_out[0])} "
+            f"from narrower inputs — {hint}",
+            eqn=eqn, name=name))
+    return out
+
+
+_REDUCE_PRIMS = {"reduce_sum", "cumsum"}
+_NARROW_INTS = ("int32", "int16", "int8", "uint32", "uint16", "uint8")
+
+
+def _reduced_elems(eqn) -> int:
+    a = _aval(eqn.invars[0])
+    shape = getattr(a, "shape", ())
+    if eqn.primitive.name in _REDUCE_PRIMS:
+        axes = eqn.params.get("axes")
+        if axes is None:
+            axis = eqn.params.get("axis")
+            axes = (axis,) if axis is not None else tuple(
+                range(len(shape)))
+        try:
+            return math.prod(int(shape[ax]) for ax in axes)
+        except (IndexError, TypeError):
+            return 0
+    if eqn.primitive.name == "dot_general":
+        dnums = eqn.params.get("dimension_numbers")
+        try:
+            (lhs_contract, _), _ = dnums
+            return math.prod(int(shape[ax]) for ax in lhs_contract)
+        except (TypeError, ValueError, IndexError):
+            return 0
+    return 0
+
+
+@_jaxpr_rule("int32-overflow-reduction", WARNING,
+             "large reduction accumulating in a narrow integer dtype")
+def _check_int_reductions(closed, cfg, name) -> List[Finding]:
+    threshold = int(cfg["int_reduce_elems"])
+    out = []
+    for eqn, path, _ in walk_eqns(closed.jaxpr):
+        if eqn.primitive.name not in _REDUCE_PRIMS | {"dot_general"}:
+            continue
+        dt = _dtype_name(eqn.invars[0])
+        if dt not in _NARROW_INTS:
+            continue
+        n = _reduced_elems(eqn)
+        if n >= threshold:
+            out.append(_finding(
+                "int32-overflow-reduction", WARNING,
+                f"{eqn.primitive.name} reduces {n} {dt} elements with a "
+                f"{dt} accumulator — overflow-prone; cast to int64/float32 "
+                "before reducing",
+                eqn=eqn, name=name, elements=n, dtype=dt))
+    return out
+
+
+def _const_nbytes(c) -> int:
+    shape = getattr(c, "shape", None)
+    dtype = getattr(c, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return math.prod(int(d) for d in shape) * dtype.itemsize
+    except (TypeError, AttributeError):
+        return 0
+
+
+def _iter_consts(closed):
+    """Yield (constvar, const, owning_jaxpr) across nested sub-jaxprs."""
+    jaxpr = closed.jaxpr
+    consts = getattr(closed, "consts", getattr(closed, "literals", ()))
+    for var, c in zip(jaxpr.constvars, consts):
+        yield var, c, jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in sub_closed_jaxprs(eqn):
+            if hasattr(sub, "jaxpr"):  # only ClosedJaxprs carry consts
+                yield from _iter_consts(sub)
+
+
+@_jaxpr_rule("oversized-constant", WARNING,
+             "large array baked into the executable as a constant")
+def _check_oversized_consts(closed, cfg, name) -> List[Finding]:
+    threshold = int(cfg["max_const_bytes"])
+    out = []
+    for var, c, jaxpr in _iter_consts(closed):
+        nbytes = _const_nbytes(c)
+        if nbytes < threshold:
+            continue
+        # attribute to the first eqn consuming the constant
+        use = next((e for e in jaxpr.eqns if var in e.invars), None)
+        shape = tuple(getattr(c, "shape", ()))
+        out.append(_finding(
+            "oversized-constant", WARNING,
+            f"constant {getattr(c, 'dtype', '?')}{list(shape)} "
+            f"({nbytes / (1 << 20):.1f} MiB) is baked into the "
+            "executable — pass it as an argument (closed-over weights "
+            "recompile on every change and bloat the serialized program)",
+            eqn=use, name=name, nbytes=nbytes))
+    return out
+
+
+def _donation_findings(invars, donated_mask, outvars, eqn, name):
+    out_avals = []
+    for v in outvars:
+        a = _aval(v)
+        if a is not None:
+            out_avals.append((tuple(getattr(a, "shape", ())),
+                              str(getattr(a, "dtype", ""))))
+    findings = []
+    for i, (v, donated) in enumerate(zip(invars, donated_mask)):
+        if not donated:
+            continue
+        a = _aval(v)
+        sig = (tuple(getattr(a, "shape", ())),
+               str(getattr(a, "dtype", "")))
+        if sig in out_avals:
+            out_avals.remove(sig)  # each output reuses one donation
+            continue
+        findings.append(_finding(
+            "unusable-donation", WARNING,
+            f"donated argument {i} ({sig[1]}{list(sig[0])}) matches no "
+            "output shape/dtype — the buffer cannot be reused and the "
+            "donation is silently dropped (and the caller's array is "
+            "still invalidated)",
+            eqn=eqn, name=name, arg_index=i))
+    return findings
+
+
+@_jaxpr_rule("unusable-donation", WARNING,
+             "donated buffer that no output can reuse")
+def _check_donation(closed, cfg, name, donate_argnums=()) -> List[Finding]:
+    out = []
+    if donate_argnums:
+        invars = closed.jaxpr.invars
+        mask = [i in set(donate_argnums) for i in range(len(invars))]
+        out.extend(_donation_findings(invars, mask, closed.jaxpr.outvars,
+                                      None, name))
+    for eqn, path, _ in walk_eqns(closed.jaxpr):
+        donated = eqn.params.get("donated_invars")
+        if donated and any(donated):
+            out.extend(_donation_findings(eqn.invars, donated, eqn.outvars,
+                                          eqn, name))
+    return out
+
+
+def _collective_sig(closed) -> tuple:
+    sig = []
+    for eqn, path, _ in walk_eqns(closed):
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            axes = eqn.params.get("axes", eqn.params.get("axis_name"))
+            if not isinstance(axes, tuple):
+                axes = (axes,)
+            sig.append((eqn.primitive.name, tuple(str(a) for a in axes)))
+    return tuple(sig)
+
+
+@_jaxpr_rule("collective-divergence", ERROR,
+             "cond branches issue different collective sequences")
+def _check_collective_divergence(closed, cfg, name) -> List[Finding]:
+    out = []
+    for eqn, path, _ in walk_eqns(closed.jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = eqn.params.get("branches") or ()
+        sigs = [_collective_sig(br) for br in branches]
+        if len(set(sigs)) > 1:
+            desc = "; ".join(
+                f"branch {i}: " + (", ".join(
+                    f"{p}({','.join(ax)})" for p, ax in s) or "none")
+                for i, s in enumerate(sigs))
+            out.append(_finding(
+                "collective-divergence", ERROR,
+                "cond branches issue different collective sequences — "
+                "if the predicate differs across devices this deadlocks "
+                f"the mesh ({desc}); issue identical collectives on every "
+                "branch or hoist them out of the cond",
+                eqn=eqn, name=name, branches=desc))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def check_jaxpr(closed, name: Optional[str] = None,
+                donate_argnums=(), config: Optional[dict] = None,
+                rules=None) -> List[Finding]:
+    """Run every (or the selected) jaxpr rule over a ClosedJaxpr.
+    Findings carry file:line from each eqn's source_info; pragmas in the
+    attributed source files are honored."""
+    cfg = dict(DEFAULT_CONFIG)
+    if config:
+        cfg.update(config)
+    out: List[Finding] = []
+    for rule_id, (severity, fn, doc) in JAXPR_RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        if rule_id == "unusable-donation":
+            out.extend(fn(closed, cfg, name, donate_argnums=donate_argnums))
+        else:
+            out.extend(fn(closed, cfg, name))
+    return filter_file_pragmas(out)
+
+
+def lint_callable(fn: Callable, *args, name: Optional[str] = None,
+                  donate_argnums=(), config: Optional[dict] = None,
+                  rules=None, **kwargs) -> List[Finding]:
+    """Trace ``fn(*args, **kwargs)`` to a jaxpr (never executing it) and
+    lint it. Accepts jax.ShapeDtypeStructs in place of real arrays."""
+    import jax
+    traced = fn if not kwargs else (lambda *a: fn(*a, **kwargs))
+    closed = jax.make_jaxpr(traced)(*args)
+    return check_jaxpr(closed, name=name or getattr(
+        fn, "__qualname__", getattr(fn, "__name__", repr(fn))),
+        donate_argnums=donate_argnums, config=config, rules=rules)
+
+
+def lint_traced(jitted: Callable, dyn_arrays, name: Optional[str] = None
+                ) -> List[Finding]:
+    """Trace-time hook used by ``to_static``: lint a fresh jit signature
+    and record the findings. Must never break the traced call — any
+    analysis failure is swallowed."""
+    from . import core as _core
+    try:
+        import jax
+        closed = jax.make_jaxpr(jitted)(*dyn_arrays)
+        found = check_jaxpr(closed, name=name)
+    except Exception:
+        return []
+    _core.record(found)
+    return found

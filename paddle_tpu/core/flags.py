@@ -66,6 +66,15 @@ define_flag("FLAGS_tpu_check_nan_inf", False,
             "to_static output checks scan for NaN/Inf, with first-bad-op "
             "localization on failure (profiler.numerics). Off: every "
             "instrumented site is a dict lookup + bool check.")
+define_flag("FLAGS_tpu_lint", False,
+            "Run the static-analysis suite (paddle_tpu.analysis jaxpr "
+            "checks) on every new to_static trace signature: host "
+            "callbacks in loop bodies, f64 promotion, int32-overflow "
+            "reductions, oversized baked constants, unusable donations, "
+            "collective divergence. Findings land in the Profiler 'Lint' "
+            "section and lint_findings_total metrics. Off: zero per-call "
+            "overhead (the check sits inside the new-signature branch; "
+            "its gate is one dict lookup + bool check).")
 define_flag("FLAGS_tpu_xmem", False,
             "Capture per-executable memory_analysis()/cost_analysis() "
             "(HBM peaks, temp bytes, flops) at every jit/Executor/"

@@ -207,7 +207,9 @@ class VisualDL(Callback):
             if "bytes_in_use" in stats:
                 self._w().add_scalar("sys/bytes_in_use",
                                      stats["bytes_in_use"], self._step)
-        except Exception:
+        # genuinely best-effort: not every PJRT backend implements
+        # memory_stats, and a telemetry miss must never fail a train step
+        except Exception:  # tpu-lint: disable=except-pass
             pass
 
     def on_epoch_end(self, epoch, logs=None):

@@ -49,9 +49,13 @@ class StaticFunction:
     """
 
     def __init__(self, fn, input_spec=None, build_strategy=None,
-                 backend=None, donate_argnums=()):
+                 backend=None, donate_argnums=(), lint=False):
         self._fn = fn
         self._input_spec = input_spec
+        # per-function opt-in to the trace-time jaxpr lint (the global
+        # switch is FLAGS_tpu_lint); checked only on new trace
+        # signatures, so steady-state calls never see it
+        self._lint = bool(lint)
         functools.update_wrapper(self, fn)
         if not getattr(fn, "_not_to_static", False):
             # dy2static AST pass: python if/while on tensor predicates
@@ -211,6 +215,16 @@ class StaticFunction:
                 self._trace_sigs.add(sig)
             from ..profiler import compile_tracker
             compile_tracker.record_trace(self._trace_name)
+            # trace-time static analysis (to_static(lint=True) or
+            # FLAGS_tpu_lint): lint the jaxpr of every NEW signature —
+            # host callbacks in loops, f64 promotion, oversized consts,
+            # donation/collective hazards — without executing anything.
+            # lint_traced never raises into the traced call.
+            from ..analysis import core as _lint_core
+            if self._lint or _lint_core.enabled():
+                from ..analysis import jaxpr_checks as _jaxpr_checks
+                _jaxpr_checks.lint_traced(jitted, dyn_arrays,
+                                          name=self._trace_name)
         # xmem capture: compile new signatures ahead-of-time so the ONE
         # compile also yields memory_analysis/cost_analysis; an
         # unhashable static leaf (key None) never caches, so it keeps
@@ -277,16 +291,21 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """@paddle.jit.to_static parity (reference: jit/api.py:222)."""
+              backend=None, lint=False, **kwargs):
+    """@paddle.jit.to_static parity (reference: jit/api.py:222).
+
+    ``lint=True`` runs the paddle_tpu.analysis jaxpr checks on every new
+    trace signature of this function (see docs/static_analysis.md);
+    ``FLAGS_tpu_lint`` enables the same checks globally."""
 
     def decorate(fn_or_layer):
         from ..nn.layer.layers import Layer
         if isinstance(fn_or_layer, Layer):
             layer = fn_or_layer
-            layer.forward = StaticFunction(layer.forward, input_spec)
+            layer.forward = StaticFunction(layer.forward, input_spec,
+                                           lint=lint)
             return layer
-        return StaticFunction(fn_or_layer, input_spec)
+        return StaticFunction(fn_or_layer, input_spec, lint=lint)
 
     if function is not None:
         return decorate(function)

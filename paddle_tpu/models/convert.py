@@ -54,7 +54,9 @@ def _to_np(v):
     if hasattr(v, "numpy"):
         try:
             v = v.numpy()
-        except Exception:
+        except (TypeError, ValueError, RuntimeError):
+            # torch-style tensors raise RuntimeError until .detach();
+            # np.asarray below is the fallback for anything array-like
             pass
     return np.asarray(v)
 

@@ -139,7 +139,13 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
         finished = prev_finished | (tokens == decoder.end_token)
         step_tokens.append(tokens.reshape(B, beam))
         step_parents.append(parent)
-        if bool(jnp.all(finished)):
+        # early-exit: the all-finished check is a device-side reduction
+        # dispatched with the rest of the step's async work; the host
+        # reads exactly ONE scalar per step via an explicit device_get
+        # (tpu_lint host-sync-in-loop: no implicit bool(jnp.all(...))
+        # blocking the dispatch queue mid-step)
+        all_done = jnp.all(finished)
+        if bool(jax.device_get(all_done)):
             break
 
     # backtrack parent ids (reference: gather_tree)

@@ -4,9 +4,20 @@ line search).
 
 The inner direction math runs on-device in fp32 (dots and axpys — XLA
 fuses the two-loop recursion); only the loop control is host-side, which
-matches the reference's Python implementation."""
+matches the reference's Python implementation.
+
+Host-sync discipline (tpu_lint: host-sync-in-loop): the two-loop
+recursion keeps rho/alpha/beta as 0-d device arrays — building a
+direction issues NO host transfers regardless of history size — and
+every host-side branch reads its scalars from ONE fused
+``jax.device_get`` of a stacked stats vector (the same shape as the
+GradScaler ``_unscale_grads`` fix). Per outer iteration that is one
+transfer for (|g|_inf, g·d), one per line-search evaluation for
+(f, g·d), and one for (s·y, |s|_inf) — down from ~10 per-scalar
+blocking ``float(jnp.dot(...))`` round-trips."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import no_grad
@@ -18,6 +29,12 @@ __all__ = ["LBFGS"]
 def _flat(arrays):
     return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
                             for a in arrays])
+
+
+def _fetch(*scalars):
+    """Fuse 0-d device scalars into one stacked array and transfer it
+    with a single explicit device->host round trip."""
+    return [float(v) for v in jax.device_get(jnp.stack(scalars))]
 
 
 class LBFGS(Optimizer):
@@ -62,26 +79,40 @@ class LBFGS(Optimizer):
 
     # -- closure evaluation ------------------------------------------------
     def _evaluate(self, closure, x):
+        """Returns (loss, grad) as DEVICE arrays — callers batch the
+        loss into their next fused stats transfer instead of paying a
+        dedicated blocking float(loss) here."""
         self._scatter(x)
         self.clear_grad()
         loss = closure()
-        return float(loss.numpy()), self._gather_grad()
+        arr = getattr(loss, "_array", loss)
+        return jnp.asarray(arr, jnp.float32).reshape(()), \
+            self._gather_grad()
+
+    def _eval_with_gtd(self, closure, x, d):
+        """Evaluate the closure at x; one fused transfer yields the loss
+        and the directional derivative g·d together."""
+        f_dev, g = self._evaluate(closure, x)
+        f, gtd = _fetch(f_dev, jnp.dot(g, d))
+        return f, g, gtd
 
     def _direction(self, g):
-        """Two-loop recursion over the (s, y) history."""
+        """Two-loop recursion over the (s, y) history — entirely on
+        device: rho/alpha/beta stay 0-d arrays, so the direction build
+        issues no host syncs and XLA fuses the dots/axpys."""
         q = -g
         alphas = []
         for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
-            rho = 1.0 / float(jnp.dot(y, s))
-            a = rho * float(jnp.dot(s, q))
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
             alphas.append((a, rho, s, y))
             q = q - a * y
         if self._s_hist:
             s, y = self._s_hist[-1], self._y_hist[-1]
-            gamma = float(jnp.dot(s, y)) / float(jnp.dot(y, y))
+            gamma = jnp.dot(s, y) / jnp.dot(y, y)
             q = q * gamma
         for a, rho, s, y in reversed(alphas):
-            b = rho * float(jnp.dot(y, q))
+            b = rho * jnp.dot(y, q)
             q = q + (a - b) * s
         return q
 
@@ -96,38 +127,49 @@ class LBFGS(Optimizer):
                 return closure()
 
         x = self._gather()
-        loss, g = self._evaluate(closure_with_grad, x)
+        loss_dev, g = self._evaluate(closure_with_grad, x)
+        loss, = _fetch(loss_dev)
         evals = 1
         for _ in range(self.max_iter):
-            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
-                break
             d = self._direction(g)
-            t = float(self.get_lr())
-            gtd = float(jnp.dot(g, d))
+            # loop-control scalars for this iteration in one transfer:
+            # |g|_inf (gradient tolerance) and g·d (descent test)
+            g_max, gtd = _fetch(jnp.max(jnp.abs(g)), jnp.dot(g, d))
+            if g_max <= self.tolerance_grad:
+                break
             if gtd > -1e-15:  # not a descent direction: reset history
                 self._s_hist.clear()
                 self._y_hist.clear()
                 d = -g
-                gtd = float(jnp.dot(g, d))
+                gtd, = _fetch(-jnp.dot(g, g))  # rare reset path
+            t = float(self.get_lr())
             if self.line_search_fn == "strong_wolfe":
                 loss_new, g_new, t, ls_evals = self._strong_wolfe(
                     closure_with_grad, x, d, t, loss, g, gtd)
                 evals += ls_evals
+                x_new = x + t * d
+                s = x_new - x
+                y = g_new - g
+                sy, s_max = _fetch(jnp.dot(s, y), jnp.max(jnp.abs(s)))
             else:
                 x_new = x + t * d
-                loss_new, g_new = self._evaluate(closure_with_grad, x_new)
+                loss_new_dev, g_new = self._evaluate(closure_with_grad,
+                                                     x_new)
                 evals += 1
-            x_new = x + t * d
-            s = x_new - x
-            y = g_new - g
-            if float(jnp.dot(s, y)) > 1e-10:
+                s = x_new - x
+                y = g_new - g
+                # curvature + convergence scalars ride the same transfer
+                # as the new loss
+                loss_new, sy, s_max = _fetch(
+                    loss_new_dev, jnp.dot(s, y), jnp.max(jnp.abs(s)))
+            if sy > 1e-10:
                 self._s_hist.append(s)
                 self._y_hist.append(y)
                 if len(self._s_hist) > self.history_size:
                     self._s_hist.pop(0)
                     self._y_hist.pop(0)
             if abs(loss_new - loss) < self.tolerance_change or \
-               float(jnp.max(jnp.abs(s))) < self.tolerance_change:
+                    s_max < self.tolerance_change:
                 x, loss, g = x_new, loss_new, g_new
                 break
             x, loss, g = x_new, loss_new, g_new
@@ -141,23 +183,24 @@ class LBFGS(Optimizer):
     def _strong_wolfe(self, closure, x, d, t, f0, g0, gtd0,
                       c1=1e-4, c2=0.9, max_ls=25):
         """Backtracking-then-zoom strong Wolfe line search
-        (reference: lbfgs.py _strong_wolfe)."""
+        (reference: lbfgs.py _strong_wolfe). Each evaluation costs ONE
+        host transfer (loss and g·d fused via _eval_with_gtd)."""
         evals = 0
         t_prev, f_prev, g_prev = 0.0, f0, g0
         f_new, g_new = f0, g0
         for i in range(max_ls):
-            f_new, g_new = self._evaluate(closure, x + t * d)
+            f_new, g_new, gtd_new = self._eval_with_gtd(closure,
+                                                        x + t * d, d)
             evals += 1
-            gtd_new = float(jnp.dot(g_new, d))
             if f_new > f0 + c1 * t * gtd0 or (i > 0 and f_new >= f_prev):
                 # zoom between t_prev and t
                 lo, hi = t_prev, t
                 f_lo = f_prev
                 for _ in range(max_ls):
                     t_mid = 0.5 * (lo + hi)
-                    f_mid, g_mid = self._evaluate(closure, x + t_mid * d)
+                    f_mid, g_mid, gtd_mid = self._eval_with_gtd(
+                        closure, x + t_mid * d, d)
                     evals += 1
-                    gtd_mid = float(jnp.dot(g_mid, d))
                     if f_mid > f0 + c1 * t_mid * gtd0 or f_mid >= f_lo:
                         hi = t_mid
                     else:
@@ -175,9 +218,9 @@ class LBFGS(Optimizer):
                 lo, hi = t, t_prev
                 for _ in range(max_ls):
                     t_mid = 0.5 * (lo + hi)
-                    f_mid, g_mid = self._evaluate(closure, x + t_mid * d)
+                    f_mid, g_mid, gtd_mid = self._eval_with_gtd(
+                        closure, x + t_mid * d, d)
                     evals += 1
-                    gtd_mid = float(jnp.dot(g_mid, d))
                     if f_mid > f0 + c1 * t_mid * gtd0:
                         hi = t_mid
                     else:

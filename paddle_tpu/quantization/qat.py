@@ -11,6 +11,7 @@ int8 export via jit.save's StableHLO path."""
 from __future__ import annotations
 
 import copy
+import logging
 
 from ..nn.layer.layers import Layer
 from .config import QuantConfig
@@ -168,15 +169,22 @@ def convert(model: Layer, inplace=False, to_int8=False) -> Layer:
                 try:
                     inner.register_buffer("weight_scale",
                                           Tensor(wq.scales()._array))
-                except Exception:
-                    pass
+                except (AttributeError, ValueError, TypeError) as e:
+                    # quanter never observed / exposes no scales: the
+                    # bake above already happened, only the exported
+                    # scale buffer is skipped
+                    logging.getLogger(__name__).debug(
+                        "convert: no weight_scale buffer for %s: %r",
+                        name, e)
             aq = sub.activation_quanter
             if aq is not None:
                 try:
                     inner.register_buffer("activation_scale",
                                           Tensor(aq.scales()._array))
-                except Exception:
-                    pass
+                except (AttributeError, ValueError, TypeError) as e:
+                    logging.getLogger(__name__).debug(
+                        "convert: no activation_scale buffer for %s: %r",
+                        name, e)
             model._sub_layers[name] = inner
         else:
             convert(sub, inplace=True, to_int8=to_int8)

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""tpu_lint: static-analysis CLI for paddle_tpu (AST rule family).
+
+Runs the paddle_tpu.analysis AST checks over Python sources and compares
+against the checked-in baseline (tools/tpu_lint_baseline.json) so new
+violations fail while the known backlog is tracked, not silenced.
+
+Usage:
+    python tools/tpu_lint.py paddle_tpu/                # lint vs baseline
+    python tools/tpu_lint.py paddle_tpu/ --baseline-update
+    python tools/tpu_lint.py some_file.py --no-baseline
+    python tools/tpu_lint.py paddle_tpu/ --rules except-pass
+
+Output: a JSON document on stdout — every finding carries severity,
+rule id, and file:line. Exit codes: 0 clean against the baseline,
+1 new warning-level findings, 2 new error-level findings.
+
+The jaxpr rule family runs at trace time instead — enable it with
+``to_static(..., lint=True)`` or ``FLAGS_tpu_lint=1`` (see
+docs/static_analysis.md). This CLI stays jax-free so it starts in
+milliseconds: the analysis package is loaded standalone.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
+                                "tpu_lint_baseline.json")
+
+
+def _load_analysis():
+    """Load paddle_tpu.analysis WITHOUT importing paddle_tpu (or jax):
+    the AST rules are stdlib-only, and a lint CLI should start fast."""
+    pkg_dir = os.path.join(REPO_ROOT, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "tpu_lint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tpu_lint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+",
+                    help="files or directories to lint")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         "when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: every finding is new")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="regenerate the baseline from current findings "
+                         "(deterministic: sorted, repo-relative paths) "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="root for baseline-relative paths "
+                         "(default: the repo root)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+
+    if args.list_rules:
+        catalogue = {rid: {"severity": sev, "doc": doc, "level": "ast"}
+                     for rid, (sev, doc) in analysis.AST_RULES.items()}
+        catalogue.update(
+            {rid: {"severity": sev, "doc": doc, "level": "jaxpr"}
+             for rid, (sev, fn, doc) in analysis.JAXPR_RULES.items()})
+        print(json.dumps(catalogue, indent=2, sort_keys=True))
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    findings = analysis.check_paths(args.paths, rules=rules)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.isfile(DEFAULT_BASELINE) else None)
+
+    if args.baseline_update:
+        path = args.baseline or DEFAULT_BASELINE
+        analysis.core.write_baseline(path, findings, args.root)
+        print(json.dumps({"baseline": path, "entries": len(findings),
+                          "updated": True}, indent=2))
+        return 0
+
+    if args.no_baseline or baseline_path is None:
+        new, fixed = findings, []
+        baseline_path = None
+    else:
+        baseline = analysis.core.load_baseline(baseline_path)
+        new, fixed = analysis.core.diff_baseline(findings, baseline,
+                                                 args.root)
+
+    counts: dict = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    new_errors = [f for f in new if f.severity == "error"]
+    doc = {
+        "tool": "tpu_lint",
+        "paths": args.paths,
+        "baseline": baseline_path,
+        "total_findings": len(findings),
+        "counts": dict(sorted(counts.items())),
+        "new": [f.to_dict() for f in new],
+        "fixed": fixed,
+        "ok": not new,
+    }
+    print(json.dumps(doc, indent=2))
+    if new_errors:
+        return 2
+    if new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
