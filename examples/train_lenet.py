@@ -7,11 +7,17 @@ metrics snapshot per epoch (optimizer step latency, dataloader wait,
 batches) plus the compile/retrace summary — see docs/observability.md.
 Per-step scalars (loss + grad norms + the full metrics snapshot) are
 appended to runs/lenet/scalars.jsonl via hapi.callbacks.ScalarLogger.
+
+Fault tolerance: CheckpointManager commits a crash-consistent checkpoint
+every 10 steps under runs/lenet/ckpt and auto-resumes from the newest
+committed step — kill the run at any instant (even mid-save) and rerun
+to continue where it left off; see docs/robustness.md.
 """
 import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
+from paddle_tpu.distributed import CheckpointManager
 from paddle_tpu.hapi.callbacks import ScalarLogger
 from paddle_tpu.io import DataLoader
 from paddle_tpu.profiler import compile_tracker, metrics
@@ -19,6 +25,7 @@ from paddle_tpu.vision.datasets import MNIST
 
 EPOCHS = 2
 STEPS_PER_EPOCH = 15
+TOTAL_STEPS = EPOCHS * STEPS_PER_EPOCH
 
 
 def main():
@@ -31,11 +38,25 @@ def main():
     loader = DataLoader(MNIST(backend="synthetic"), batch_size=64,
                         shuffle=True)
     logger = ScalarLogger("runs/lenet")
+    mgr = CheckpointManager("runs/lenet/ckpt", save_interval_steps=10,
+                            keep=2, backend="pickle")
+    ckpt, start = mgr.restore()
+    if start >= TOTAL_STEPS:  # the previous run finished: start fresh
+        import shutil
+        shutil.rmtree(mgr.root)
+        mgr = CheckpointManager(mgr.root, save_interval_steps=10,
+                                keep=2, backend="pickle")
+        ckpt, start = None, 0
+        print("previous run complete; starting a fresh one")
+    if ckpt is not None:
+        net.set_state_dict(ckpt["net"])
+        opt.set_state_dict(ckpt["opt"])
+        print(f"resumed from committed step {start}")
     losses = []
-    step = 0
+    step = start
     it = iter(loader)
-    for epoch in range(EPOCHS):
-        for _ in range(STEPS_PER_EPOCH):
+    for epoch in range(start // STEPS_PER_EPOCH, EPOCHS):
+        for _ in range(step % STEPS_PER_EPOCH, STEPS_PER_EPOCH):
             img, label = next(it)
             loss = loss_fn(net(img), paddle.reshape(label, [-1]))
             loss.backward()
@@ -44,6 +65,8 @@ def main():
             losses.append(float(loss.numpy()))
             step += 1
             logger.log(step, loss=losses[-1])
+            mgr.step_end(step, {"net": net.state_dict(),
+                                "opt": opt.state_dict()})
         snap = metrics.snapshot()
         steps = snap.get("optimizer_steps_total", 0)
         step_lat = snap.get("optimizer_step_seconds", {})
@@ -53,10 +76,13 @@ def main():
               f"step p50 {step_lat.get('p50', 0) * 1e3:.1f} ms | "
               f"data wait p50 {data_lat.get('p50', 0) * 1e3:.1f} ms")
     logger.close()
+    mgr.close()
     cs = compile_tracker.stats()
     print(f"compiles: {cs['compile_count']} "
           f"({cs['compile_seconds']:.2f} s), retraces: {cs['retraces']}")
     print(f"scalars: {logger.path}")
+    print(f"checkpoints: runs/lenet/ckpt (committed steps "
+          f"{mgr.all_steps()})")
     print(f"lenet: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
     assert losses[-1] < losses[0]
 

@@ -28,6 +28,8 @@ from .recompute import recompute, recompute_sequential
 from . import fleet
 from . import sharding
 from . import checkpoint
+from . import fault_tolerance
+from .fault_tolerance import CheckpointManager, PreemptionHandler
 from . import pipeline
 from . import rpc
 from . import auto_parallel
@@ -53,6 +55,7 @@ __all__ = [
     "replicate_params", "RNGStatesTracker", "get_rng_state_tracker",
     "model_parallel_random_seed", "fleet", "sharding", "spawn", "launch",
     "recompute", "recompute_sequential", "pipeline", "rpc", "auto_parallel",
+    "fault_tolerance", "CheckpointManager", "PreemptionHandler",
 ]
 
 

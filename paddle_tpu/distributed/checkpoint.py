@@ -13,6 +13,15 @@ NamedShardings — the reference's manual merge/re-slice pass collapses
 into device_put-on-restore. Saving is async: the train loop keeps
 stepping while shards stream out (``sync=False``).
 
+Crash consistency (fault_tolerance module): every save streams into a
+``*.ptq-tmp`` sibling, records a fsynced manifest (sizes + CRC32s +
+step), and publishes with one atomic directory rename. Readers
+(``latest_step`` / ``load`` / ``load_train_state``) only ever see
+committed directories, verify the manifest before restoring, and fall
+back to the previous committed step on corruption. Pruning never removes
+the newest committed step and never touches a step an async save is
+still writing.
+
 Typical use with the flagship train step (models.llama.build_train_step):
 
     step_fn, init_fn = build_train_step(cfg, topo)
@@ -28,18 +37,32 @@ Typical use with the flagship train step (models.llama.build_train_step):
 from __future__ import annotations
 
 import os
-import re
 import shutil
-from typing import Any, Optional, Tuple
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import jax
 
-__all__ = ["save", "load", "save_train_state", "load_train_state",
-           "latest_step", "abstract_like", "wait_until_finished"]
+from . import fault_tolerance as ft
+from ..testing.chaos import chaos_point
 
-_STEP_RE = re.compile(r"^step_(\d+)$")
+__all__ = ["save", "load", "save_step", "load_step", "save_train_state",
+           "load_train_state", "latest_step", "abstract_like",
+           "wait_until_finished", "CheckpointCorruptionError"]
+
+CheckpointCorruptionError = ft.CheckpointCorruptionError
 
 _CKPTR = None
+
+# async commit machinery: each sync=False save hands its tmp->final
+# publish to a waiter thread; wait_until_finished() joins them all, and
+# pruning consults _INFLIGHT so a streaming step is never swept
+_ASYNC_LOCK = threading.Lock()
+_ASYNC_THREADS: List[threading.Thread] = []
+_ASYNC_ERRORS: List[BaseException] = []
+_INFLIGHT: Dict[str, Set[int]] = {}  # root -> steps still streaming
 
 
 def _checkpointer():
@@ -53,9 +76,22 @@ def _checkpointer():
 
 
 def wait_until_finished():
-    """Block until every async save (sync=False) has committed."""
+    """Block until every async save (sync=False) has committed. Raises
+    the first deferred commit failure, if any."""
     if _CKPTR is not None:
         _CKPTR.wait_until_finished()
+    while True:
+        with _ASYNC_LOCK:
+            live = [t for t in _ASYNC_THREADS if t.is_alive()]
+            if not live:
+                _ASYNC_THREADS.clear()
+                errs = list(_ASYNC_ERRORS)
+                _ASYNC_ERRORS.clear()
+                break
+        for t in live:
+            t.join()
+    if errs:
+        raise errs[0]
 
 
 def abstract_like(tree):
@@ -70,68 +106,171 @@ def abstract_like(tree):
     return jax.tree_util.tree_map(conv, tree)
 
 
-def save(path: str, tree: Any, *, overwrite: bool = True,
-         sync: bool = True) -> None:
-    """Save a pytree of (sharded) arrays as one logical checkpoint."""
-    path = os.path.abspath(path)
-    if os.path.exists(path):
-        if not overwrite:
-            raise FileExistsError(path)
-        shutil.rmtree(path)
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+# ---------------------------------------------------------------------------
+# commit plumbing
+# ---------------------------------------------------------------------------
+
+def _finalize(tmp: str, final: str, t0: float, step: Optional[int],
+              root: Optional[str], keep: Optional[int]):
+    """Publish a durable tmp dir: manifest -> atomic rename -> metrics ->
+    inflight bookkeeping -> pruning. Runs inline for sync saves, on the
+    waiter thread for async ones (so pruning naturally waits on them)."""
+    try:
+        chaos_point("ckpt.commit.pre", step=step, path=final)
+        extra = {"step": step} if step is not None else None
+        man = ft.commit_dir(tmp, final, overwrite=True, extra=extra)
+        chaos_point("ckpt.commit.post", step=step, path=final)
+        ft.record_save(time.perf_counter() - t0, man["bytes_total"],
+                       step=step)
+    finally:
+        if root is not None and step is not None:
+            with _ASYNC_LOCK:
+                _INFLIGHT.get(root, set()).discard(step)
+    if root is not None and keep is not None:
+        with _ASYNC_LOCK:
+            inflight = set(_INFLIGHT.get(root, set()))
+        ft.prune_steps(root, keep, inflight=inflight)
+
+
+def _save_impl(final: str, tree: Any, *, overwrite: bool, sync: bool,
+               step: Optional[int] = None, root: Optional[str] = None,
+               keep: Optional[int] = None) -> None:
+    if os.path.exists(final) and not overwrite:
+        raise FileExistsError(final)
+    os.makedirs(os.path.dirname(final) or ".", exist_ok=True)
+    tmp = final + ft.TMP_SUFFIX
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)  # stale leftover from a crashed save
+    if root is not None and step is not None:
+        with _ASYNC_LOCK:
+            _INFLIGHT.setdefault(root, set()).add(step)
+    chaos_point("ckpt.save.pre", step=step, path=final)
+    t0 = time.perf_counter()
     ckptr = _checkpointer()
-    ckptr.save(path, tree)
+    try:
+        ckptr.save(tmp, tree)
+    except BaseException:
+        if root is not None and step is not None:
+            with _ASYNC_LOCK:
+                _INFLIGHT.get(root, set()).discard(step)
+        raise
     if sync:
         ckptr.wait_until_finished()
+        _finalize(tmp, final, t0, step, root, keep)
+        return
+
+    def _wait_and_commit():
+        try:
+            # waits for ALL pending orbax ops — ours included; a later
+            # save's data becoming durable first is harmless
+            _checkpointer().wait_until_finished()
+            _finalize(tmp, final, t0, step, root, keep)
+        except BaseException as e:  # surfaced by wait_until_finished()
+            with _ASYNC_LOCK:
+                _ASYNC_ERRORS.append(e)
+
+    th = threading.Thread(target=_wait_and_commit, daemon=True,
+                          name="ptq-ckpt-commit")
+    with _ASYNC_LOCK:
+        _ASYNC_THREADS.append(th)
+    th.start()
 
 
-def load(path: str, target: Any = None) -> Any:
+def save(path: str, tree: Any, *, overwrite: bool = True,
+         sync: bool = True) -> None:
+    """Save a pytree of (sharded) arrays as one logical checkpoint.
+    Crash-consistent: the previous checkpoint at ``path`` survives until
+    the replacement has fully committed."""
+    _save_impl(os.path.abspath(path), tree, overwrite=overwrite, sync=sync)
+
+
+def load(path: str, target: Any = None, *, verify: bool = True) -> Any:
     """Restore a checkpoint. ``target`` (a tree of arrays or
     ShapeDtypeStructs) dictates shapes/dtypes/shardings on the current
     mesh — pass the init_fn output of the new topology to reshard; omit it
-    to restore with the shardings recorded at save time."""
-    path = os.path.abspath(path)
+    to restore with the shardings recorded at save time.
+
+    Only committed checkpoints are visible: a half-written directory is
+    recovered to the last committed copy or rejected, and ``verify=True``
+    checks the manifest (sizes + CRC32s) before any deserialization."""
+    path = ft.recover_dir(os.path.abspath(path))
+    if verify:
+        ft.verify_dir(path)
     ckptr = _checkpointer()
     if target is None:
         return ckptr.restore(path)
     return ckptr.restore(path, abstract_like(target))
 
 
+# ---------------------------------------------------------------------------
+# step-directory train-state API
+# ---------------------------------------------------------------------------
+
 def latest_step(root: str) -> Optional[int]:
-    root = os.path.abspath(root)
-    if not os.path.isdir(root):
-        return None
-    steps = [int(m.group(1)) for d in os.listdir(root)
-             if (m := _STEP_RE.match(d))]
-    return max(steps) if steps else None
+    """Newest COMMITTED step under ``root`` — never a half-written
+    ``step_*`` directory."""
+    return ft.latest_committed_step(root)
 
 
 def _step_dir(root: str, step: int) -> str:
-    return os.path.join(os.path.abspath(root), f"step_{step:08d}")
+    return os.path.join(os.path.abspath(root), ft.step_dir_name(step))
+
+
+def save_step(root: str, state: Any, step: int, *, keep: int = 3,
+              sync: bool = True) -> str:
+    """Save an arbitrary pytree under root/step_N with the commit
+    protocol, pruning old committed steps (keep=0 keeps all). Pruning
+    skips steps still streaming in async saves and never removes the
+    newest committed step."""
+    root_abs = os.path.abspath(root)
+    d = _step_dir(root, step)
+    _save_impl(d, state, overwrite=True, sync=sync, step=step,
+               root=root_abs, keep=keep)
+    return d
+
+
+def load_step(root: str, target: Any = None, step: Optional[int] = None,
+              ) -> Tuple[Any, int]:
+    """(state, step) from ``root`` — the requested step, or the newest
+    committed one, falling back past corrupt steps (each fallback
+    increments ``ckpt_restore_fallback_total``)."""
+    if step is not None:
+        state = load(_step_dir(root, step), target)
+        ft.record_restore(step)
+        return state, step
+    steps = ft.committed_steps(root)
+    if not steps:
+        raise FileNotFoundError(
+            f"no committed step_* checkpoints under {root}")
+    for s in reversed(steps):
+        try:
+            state = load(_step_dir(root, s), target)
+        except (ft.CheckpointCorruptionError, FileNotFoundError) as e:
+            sys.stderr.write(
+                f"checkpoint: step {s} under {root} failed verification "
+                f"({e}); falling back to the previous committed step\n")
+            ft.record_fallback(s)
+            continue
+        ft.record_restore(s)
+        return state, s
+    raise ft.CheckpointCorruptionError(
+        f"every committed step under {root} failed verification "
+        f"(tried {list(reversed(steps))})")
 
 
 def save_train_state(root: str, params: Any, opt_state: Any, step: int,
                      *, keep: int = 3, sync: bool = True) -> str:
     """Save (params, opt_state) under root/step_N, pruning old steps."""
-    d = _step_dir(root, step)
-    save(d, {"params": params, "opt_state": opt_state}, sync=sync)
-    steps = sorted(int(m.group(1)) for x in os.listdir(os.path.abspath(root))
-                   if (m := _STEP_RE.match(x)))
-    for s in steps[:-keep] if keep else []:
-        shutil.rmtree(_step_dir(root, s), ignore_errors=True)
-    return d
+    return save_step(root, {"params": params, "opt_state": opt_state},
+                     step, keep=keep, sync=sync)
 
 
 def load_train_state(root: str, params_target: Any = None,
                      opt_state_target: Any = None,
                      step: Optional[int] = None
                      ) -> Tuple[Any, Any, int]:
-    """Restore (params, opt_state, step) from root (latest step unless
-    given), resharded onto the targets' placements."""
-    if step is None:
-        step = latest_step(root)
-        if step is None:
-            raise FileNotFoundError(f"no step_* checkpoints under {root}")
+    """Restore (params, opt_state, step) from root (latest committed
+    step unless given), resharded onto the targets' placements."""
     if (params_target is None) != (opt_state_target is None):
         raise ValueError(
             "pass both params_target and opt_state_target (the restore "
@@ -139,5 +278,5 @@ def load_train_state(root: str, params_target: Any = None,
     target = None
     if params_target is not None:
         target = {"params": params_target, "opt_state": opt_state_target}
-    state = load(_step_dir(root, step), target)
-    return state["params"], state["opt_state"], step
+    state, got = load_step(root, target, step=step)
+    return state["params"], state["opt_state"], got

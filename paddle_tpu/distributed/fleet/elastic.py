@@ -75,9 +75,13 @@ def start_heartbeat(interval: float = 2.0, store=None) -> threading.Event:
     store.add(key, 1)
 
     def beat():
+        from ...testing.chaos import chaos_point
         while not stop.is_set():
             stop.wait(interval)
             try:
+                # chaos "hang@elastic.heartbeat" stalls the beat so tests
+                # can prove the monitor declares this rank hung
+                chaos_point("elastic.heartbeat", path=None, key=key)
                 store.add(key, 1)
             except Exception:
                 return  # store gone: the pod is coming down anyway

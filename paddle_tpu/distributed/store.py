@@ -20,8 +20,17 @@ import time
 from typing import Optional
 
 from ..core import native
+from ..testing.chaos import chaos_point
 
 __all__ = ["TCPStore"]
+
+# transient client-side failures worth retrying: connection drops and
+# generic socket I/O errors (the native wrapper surfaces them as
+# IOError). TimeoutError — although an OSError subclass — means the
+# server-side budget expired and retrying would double it, so it is in
+# the give-up set, as are programming errors.
+_TRANSIENT = (ConnectionError, OSError)
+_GIVE_UP = (TimeoutError,)
 
 
 class _NativeStore:
@@ -132,11 +141,19 @@ class _PyStore:
 
 class TCPStore:
     """paddle-compatible surface: TCPStore(host, port, is_master,
-    world_size, timeout). Values are bytes; helpers for python objects."""
+    world_size, timeout). Values are bytes; helpers for python objects.
+
+    Client ``get``/``set``/``add`` retry transient socket failures with
+    bounded exponential backoff + jitter (a preempted master restarting,
+    a dropped connection mid-rendezvous); non-transient errors and
+    timeouts raise immediately. ``_sleep``/``_retry_rng`` are injectable
+    so tests can assert the schedule without real waiting."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
-                 timeout: float = 300.0):
+                 timeout: float = 300.0, retries: int = 4,
+                 retry_base_delay: float = 0.05,
+                 retry_max_delay: float = 2.0):
         self.host = host
         self.world_size = world_size
         if native.available():
@@ -145,14 +162,47 @@ class TCPStore:
             self._impl = _PyStore(host, port, is_master, timeout)
         self.port = self._impl.port
         self.is_native = isinstance(self._impl, _NativeStore)
+        self.retries = int(os.environ.get("PTQ_STORE_RETRIES", retries))
+        self.retry_base_delay = retry_base_delay
+        self.retry_max_delay = retry_max_delay
+        self._sleep = time.sleep
+        self._retry_rng = None  # None -> fresh jitter per call chain
+
+    def _with_retries(self, what: str, fn):
+        from .fault_tolerance import retry_with_backoff
+
+        def _on_retry(attempt, exc, delay):
+            import sys
+            sys.stderr.write(
+                f"TCPStore.{what}: transient failure ({exc}); retry "
+                f"{attempt}/{self.retries - 1} in {delay:.2f}s\n")
+            from ..profiler import metrics
+            if metrics.enabled():
+                metrics.counter("store_retry_total",
+                                "TCPStore transient-error retries",
+                                op=what).inc()
+
+        return retry_with_backoff(
+            fn, retryable=_TRANSIENT, give_up=_GIVE_UP,
+            attempts=self.retries, base_delay=self.retry_base_delay,
+            max_delay=self.retry_max_delay, sleep=self._sleep,
+            rng=self._retry_rng, on_retry=_on_retry)
 
     def set(self, key: str, value) -> None:
         if not isinstance(value, (bytes, bytearray)):
             value = pickle.dumps(value)
-        self._impl.set(key, bytes(value))
+        data = bytes(value)
+
+        def _op():
+            chaos_point("store.set", path=None, key=key)
+            self._impl.set(key, data)
+        self._with_retries("set", _op)
 
     def get(self, key: str) -> Optional[bytes]:
-        return self._impl.get(key)
+        def _op():
+            chaos_point("store.get", path=None, key=key)
+            return self._impl.get(key)
+        return self._with_retries("get", _op)
 
     def wait(self, key: str) -> bytes:
         return self._impl.wait(key)
@@ -162,7 +212,10 @@ class TCPStore:
         return pickle.loads(raw)
 
     def add(self, key: str, delta: int = 1) -> int:
-        return self._impl.add(key, delta)
+        def _op():
+            chaos_point("store.add", path=None, key=key)
+            return self._impl.add(key, delta)
+        return self._with_retries("add", _op)
 
     def delete_key(self, key: str) -> bool:
         return self._impl.delete(key)

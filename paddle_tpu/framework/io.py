@@ -16,6 +16,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..testing.chaos import chaos_point
 
 __all__ = ["save", "load"]
 
@@ -56,18 +57,43 @@ def _unpack(obj):
 
 
 def save(obj, path, protocol=4, **configs):
+    """Crash-consistent: pickle into a tmp sibling, flush+fsync, then
+    atomically ``os.replace`` over ``path`` — a kill at any instant
+    leaves either the previous complete file or the new one, never a
+    truncated hybrid."""
     if hasattr(path, "write"):
         pickle.dump(_pack(obj), path, protocol=protocol)
         return
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    tmp = f"{path}.ptq-tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        chaos_point("io.save.pre_commit", path=path)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # interrupted before the commit rename
+            os.remove(tmp)
 
 
 def load(path, **configs):
+    name = getattr(path, "name", None) or repr(path)
     if hasattr(path, "read"):
-        return _unpack(pickle.load(path))
-    with open(path, "rb") as f:
-        return _unpack(pickle.load(f))
+        try:
+            return _unpack(pickle.load(path))
+        except (pickle.UnpicklingError, EOFError) as e:
+            raise RuntimeError(
+                f"checkpoint stream {name} is truncated or corrupt "
+                f"({type(e).__name__}: {e})") from e
+    try:
+        with open(path, "rb") as f:
+            return _unpack(pickle.load(f))
+    except (pickle.UnpicklingError, EOFError) as e:
+        raise RuntimeError(
+            f"checkpoint file {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e}); the writing process was likely "
+            f"killed mid-save — restore an earlier checkpoint") from e

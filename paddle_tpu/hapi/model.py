@@ -111,7 +111,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            handle_preemption=False):
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         eval_loader = self._as_loader(eval_data, batch_size, False, False,
@@ -126,13 +127,23 @@ class Model:
         history = {"loss": []}
         self.stop_training = False
         it = 0
+        # opt-in preemption contract (fleet/elastic exit-101 protocol):
+        # SIGTERM/SIGINT finishes the current batch, saves a final
+        # checkpoint into save_dir, and exits RELAUNCH_EXIT_CODE so an
+        # elastic supervisor respawns the job for free
+        preempt = None
+        if handle_preemption:
+            from ..distributed.fault_tolerance import PreemptionHandler
+            preempt = PreemptionHandler()
         cbs.on_train_begin()
         try:
             self._fit_loop(loader, eval_loader, epochs, eval_freq,
                            save_dir, save_freq, verbose, log_freq,
                            accumulate_grad_batches, num_iters, history,
-                           cbs)
+                           cbs, preempt)
         finally:
+            if preempt is not None:
+                preempt.uninstall()
             cbs.on_train_end({"loss": history["loss"][-1]
                               if history["loss"] else None})
         return history
@@ -149,9 +160,18 @@ class Model:
                 logs[name] = val
         return logs
 
+    def _preempt_exit(self, preempt, save_dir, verbose):
+        """Final synchronous checkpoint, then exit 101 for relaunch."""
+        if save_dir is not None:
+            self.save(f"{save_dir}/preempted")
+        if verbose:
+            print("preemption: checkpoint saved, exiting for relaunch",
+                  flush=True)
+        preempt.exit_for_relaunch()  # raises SystemExit(101)
+
     def _fit_loop(self, loader, eval_loader, epochs, eval_freq, save_dir,
                   save_freq, verbose, log_freq, accumulate_grad_batches,
-                  num_iters, history, cbs):
+                  num_iters, history, cbs, preempt=None):
         it = 0
         for epoch in range(epochs):
             for m in self._metrics:
@@ -171,6 +191,8 @@ class Model:
                 logs = {"loss": float(loss_vals[0])}
                 logs.update(self._metric_logs())
                 cbs.on_train_batch_end(step, logs)
+                if preempt is not None and preempt.requested():
+                    self._preempt_exit(preempt, save_dir, verbose)
                 if verbose and step % log_freq == 0:
                     msg = (f"Epoch {epoch + 1}/{epochs} step {step} "
                            f"loss: {loss_vals[0]:.4f}")

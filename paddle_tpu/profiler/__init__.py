@@ -323,6 +323,9 @@ class Profiler:
         from ..analysis import core as _lint_core
         lines.extend(_lint_core.summary_lines())
         lines.append("-" * len(header))
+        from ..distributed import fault_tolerance as _ft
+        lines.extend(_ft.summary_lines())
+        lines.append("-" * len(header))
         if self._step_times:
             lines.append(self.step_info(time_unit))
         return "\n".join(lines)

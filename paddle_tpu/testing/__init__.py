@@ -1,0 +1,10 @@
+"""Test-support utilities (fault injection, determinism helpers).
+
+Reference analog: the reference ships fault-injection hooks inside its
+fleet elastic tests (test_fleet_elastic_manager.py's fake etcd / forced
+worker death); here the harness is a first-class module so any layer can
+prove kill-anywhere crash consistency.
+"""
+from . import chaos
+
+__all__ = ["chaos"]

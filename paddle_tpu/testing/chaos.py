@@ -1,0 +1,244 @@
+"""Deterministic fault-injection harness.
+
+Reference analog: the elastic stack's failure-path tests
+(fleet/elastic/manager.py is exercised in the reference by forcing
+worker death / heartbeat loss); production TPU fleets (PAPERS.md,
+Gemma-on-Cloud-TPU) treat preemption and partial failure as routine, so
+the recovery paths need to be provable, not hopeful.
+
+This module plants named *chaos points* inside the framework's
+persistence and rendezvous code (checkpoint commit, pickle save, store
+client ops, heartbeat loop). A test installs a :class:`Chaos` schedule
+and every matching point fires an injected fault:
+
+    crash       os._exit(code)        — kill -9 mid-save semantics
+    raise       raise ChaosError      — in-process crash simulation
+    sigterm     SIGTERM to self       — preemption notice
+    hang        sleep(sleep_s)        — stuck worker / heartbeat stall
+    disconnect  raise ConnectionResetError — transient store failure
+    truncate    truncate the file at the point's ``path``
+
+Schedules are deterministic: rules match on point name (fnmatch
+pattern), optional ``step``, fire at most ``times`` times after skipping
+``after`` hits, and probabilistic rules draw from a seeded RNG so a
+given seed always injects the same faults in the same order.
+
+Spec grammar (also accepted from the ``PTQ_CHAOS`` env var, so
+subprocess workers opt in without code changes)::
+
+    action@point[:key=value[,key=value...]][;action@point...]
+
+    PTQ_CHAOS="crash@ckpt.commit.pre:step=3" python train.py
+    PTQ_CHAOS="disconnect@store.get:times=2;sigterm@train.step:step=5"
+
+Instrumented code calls :func:`chaos_point`; with no schedule installed
+that is one module-global ``None`` check — production paths pay nothing.
+"""
+from __future__ import annotations
+
+import fnmatch
+import os
+import signal
+import time
+from contextlib import contextmanager
+from typing import Iterable, List, Optional, Union
+
+__all__ = ["Chaos", "ChaosError", "Rule", "chaos_point", "install",
+           "uninstall", "active", "installed", "install_from_env",
+           "truncate_file", "corrupt_file"]
+
+ACTIONS = ("crash", "raise", "sigterm", "hang", "disconnect", "truncate")
+
+
+class ChaosError(RuntimeError):
+    """Injected in-process fault (the ``raise`` action)."""
+
+
+class Rule:
+    """One injection: fire ``action`` when a chaos point matches."""
+
+    def __init__(self, action: str, point: str, *, step: Optional[int] = None,
+                 times: Optional[int] = None, after: int = 0,
+                 prob: Optional[float] = None, exit_code: int = 42,
+                 frac: float = 0.5, sleep_s: float = 3600.0):
+        if action not in ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}; "
+                             f"one of {ACTIONS}")
+        self.action = action
+        self.point = point
+        self.step = step
+        self.times = times
+        self.after = int(after)
+        self.prob = prob
+        self.exit_code = int(exit_code)
+        self.frac = float(frac)
+        self.sleep_s = float(sleep_s)
+        self.hits = 0    # matching visits (post step-filter)
+        self.fired = 0   # times the fault actually fired
+
+    _INT_KEYS = {"step", "times", "after", "exit_code"}
+    _FLOAT_KEYS = {"prob", "frac", "sleep_s"}
+
+    @classmethod
+    def parse(cls, spec: str) -> "Rule":
+        """``action@point[:k=v,...]`` -> Rule."""
+        head, _, opts = spec.strip().partition(":")
+        action, sep, point = head.partition("@")
+        if not sep or not point:
+            raise ValueError(
+                f"bad chaos rule {spec!r}: expected 'action@point[:k=v]'")
+        kwargs = {}
+        for kv in filter(None, (s.strip() for s in opts.split(","))):
+            k, sep, v = kv.partition("=")
+            if not sep:
+                raise ValueError(f"bad chaos option {kv!r} in {spec!r}")
+            if k in cls._INT_KEYS:
+                kwargs[k] = int(v)
+            elif k in cls._FLOAT_KEYS:
+                kwargs[k] = float(v)
+            else:
+                raise ValueError(f"unknown chaos option {k!r} in {spec!r}")
+        return cls(action.strip(), point.strip(), **kwargs)
+
+    def __repr__(self):
+        return (f"Rule({self.action}@{self.point} step={self.step} "
+                f"times={self.times} fired={self.fired})")
+
+
+class Chaos:
+    """A seeded, deterministic schedule of injected faults."""
+
+    def __init__(self, rules: Union[str, Iterable] = (), seed: int = 0):
+        import random
+        self.rules: List[Rule] = []
+        self._rng = random.Random(seed)
+        self.log: list = []  # (point, step, action) for test assertions
+        if isinstance(rules, str):
+            for spec in filter(None, (s.strip() for s in rules.split(";"))):
+                self.rules.append(Rule.parse(spec))
+        else:
+            for r in rules:
+                self.rules.append(r if isinstance(r, Rule)
+                                  else Rule.parse(r))
+
+    def rule(self, action: str, point: str, **kw) -> "Chaos":
+        """Builder-style: ``Chaos().rule("raise", "ckpt.commit.pre")``."""
+        self.rules.append(Rule(action, point, **kw))
+        return self
+
+    def hit(self, point: str, step: Optional[int] = None,
+            path: Optional[str] = None, **_kw):
+        for r in self.rules:
+            if not fnmatch.fnmatchcase(point, r.point):
+                continue
+            if r.step is not None and step != r.step:
+                continue
+            r.hits += 1
+            if r.hits <= r.after:
+                continue
+            if r.times is not None and r.fired >= r.times:
+                continue
+            if r.prob is not None and self._rng.random() >= r.prob:
+                continue
+            r.fired += 1
+            self.log.append((point, step, r.action))
+            self._fire(r, point, step, path)
+
+    def _fire(self, r: Rule, point: str, step, path):
+        if r.action == "crash":
+            # the real thing: no cleanup, no atexit, no flush — exactly
+            # what a preempted VM or OOM-killed worker looks like
+            os._exit(r.exit_code)
+        if r.action == "raise":
+            raise ChaosError(f"chaos: injected crash at {point} "
+                             f"(step={step})")
+        if r.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if r.action == "hang":
+            time.sleep(r.sleep_s)
+            return
+        if r.action == "disconnect":
+            raise ConnectionResetError(
+                f"chaos: injected disconnect at {point} (step={step})")
+        if r.action == "truncate":
+            if path and os.path.isfile(path):
+                truncate_file(path, keep_frac=r.frac)
+
+
+_ACTIVE: Optional[Chaos] = None
+
+
+def chaos_point(name: str, step: Optional[int] = None,
+                path: Optional[str] = None, **kw):
+    """Instrumentation hook. No-op (one None check) unless a schedule is
+    installed via :func:`install` / ``PTQ_CHAOS``."""
+    if _ACTIVE is None:
+        return
+    _ACTIVE.hit(name, step=step, path=path, **kw)
+
+
+def install(chaos: Chaos) -> Chaos:
+    global _ACTIVE
+    _ACTIVE = chaos
+    return chaos
+
+
+def uninstall():
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[Chaos]:
+    return _ACTIVE
+
+
+@contextmanager
+def installed(chaos: Union[Chaos, str]):
+    """``with chaos.installed(Chaos().rule(...)):`` — scoped injection."""
+    c = chaos if isinstance(chaos, Chaos) else Chaos(chaos)
+    prev = _ACTIVE
+    install(c)
+    try:
+        yield c
+    finally:
+        install(prev) if prev is not None else uninstall()
+
+
+def install_from_env() -> Optional[Chaos]:
+    """Activate the schedule in ``PTQ_CHAOS`` (seed: ``PTQ_CHAOS_SEED``).
+    Called at import so subprocess workers need only the env var."""
+    spec = os.environ.get("PTQ_CHAOS")
+    if not spec:
+        return None
+    return install(Chaos(spec, seed=int(os.environ.get("PTQ_CHAOS_SEED",
+                                                       "0"))))
+
+
+# -- file corruption helpers (manifest/fallback tests) -----------------------
+
+def truncate_file(path: str, keep_frac: float = 0.5):
+    """Cut a file short — what a crashed writer leaves behind."""
+    size = os.path.getsize(path)
+    keep = max(0, int(size * keep_frac))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def corrupt_file(path: str, nbytes: int = 8, seed: int = 0):
+    """Flip ``nbytes`` bytes at seeded offsets (bit-rot / torn write)."""
+    import random
+    rng = random.Random(seed)
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    with open(path, "r+b") as f:
+        for _ in range(nbytes):
+            off = rng.randrange(size)
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
+install_from_env()

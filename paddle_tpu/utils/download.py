@@ -13,7 +13,10 @@ from __future__ import annotations
 import hashlib
 import os
 import shutil
+import socket
+import sys
 import tarfile
+import time
 import zipfile
 
 __all__ = ["get_weights_path_from_url", "get_path_from_url",
@@ -69,10 +72,29 @@ def _decompress(path: str) -> str:
     return out
 
 
-def _fetch(url: str, dst: str):
+def _is_transient(e: Exception) -> bool:
+    """Worth retrying: connection drops, timeouts, truncated bodies, DNS
+    hiccups, and 408/429/5xx responses. A 404 or SSL failure is not."""
+    import http.client
+    from urllib.error import HTTPError, URLError
+    if isinstance(e, HTTPError):
+        return e.code in (408, 429) or 500 <= e.code < 600
+    if isinstance(e, URLError):
+        return True  # DNS / refused / reset — the reason is an OSError
+    return isinstance(e, (ConnectionError, TimeoutError, socket.timeout,
+                          http.client.IncompleteRead,
+                          http.client.HTTPException))
+
+
+def _fetch(url: str, dst: str, md5sum: str | None = None,
+           attempts: int = 3, sleep=time.sleep):
     """Copy/download ``url`` to ``dst``. Local paths and file:// copy;
-    http(s) uses urllib (raises a clear error when the host has no
-    egress, pointing at the local-path alternative)."""
+    http(s) uses urllib with bounded retry + exponential backoff on
+    transient failures (raises a clear error when the host has no
+    egress, pointing at the local-path alternative). The expected
+    checksum is verified on the temp file BEFORE the rename into the
+    cache, so a truncated or corrupted transfer is never served later as
+    a valid cached artifact."""
     if url.startswith("file://"):
         url = url[len("file://"):]
     tmp = dst + ".tmp"  # never leave a truncated file at the cache path:
@@ -81,18 +103,34 @@ def _fetch(url: str, dst: str):
             shutil.copy(url, tmp)
         elif url.startswith(("http://", "https://")):
             import urllib.request
-            try:
-                with urllib.request.urlopen(url, timeout=60) as r, \
-                        open(tmp, "wb") as f:
-                    shutil.copyfileobj(r, f)
-            except Exception as e:
-                raise RuntimeError(
-                    f"download of {url} failed ({e}); on air-gapped "
-                    f"hosts, place the file locally and pass its path, "
-                    f"or pre-seed the cache at {os.path.dirname(dst)}"
-                ) from e
+            delay = 1.0
+            for attempt in range(1, attempts + 1):
+                try:
+                    with urllib.request.urlopen(url, timeout=60) as r, \
+                            open(tmp, "wb") as f:
+                        shutil.copyfileobj(r, f)
+                    break
+                except Exception as e:
+                    if attempt < attempts and _is_transient(e):
+                        sys.stderr.write(
+                            f"download: transient failure for {url} "
+                            f"({e}); retry {attempt}/{attempts - 1} in "
+                            f"{delay:.0f}s\n")
+                        sleep(delay)
+                        delay *= 2
+                        continue
+                    raise RuntimeError(
+                        f"download of {url} failed after {attempt} "
+                        f"attempt(s) ({e}); on air-gapped hosts, place "
+                        f"the file locally and pass its path, or "
+                        f"pre-seed the cache at {os.path.dirname(dst)}"
+                    ) from e
         else:
             raise FileNotFoundError(f"no such artifact source: {url}")
+        if not _md5check(tmp, md5sum):
+            raise RuntimeError(
+                f"md5 mismatch for {url} (expected {md5sum}): the "
+                f"transfer was truncated or corrupted; nothing cached")
         os.replace(tmp, dst)
     finally:
         if os.path.exists(tmp):
@@ -109,10 +147,7 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str | None = None,
     fullpath = os.path.join(root_dir, fname)
     if not (check_exist and os.path.exists(fullpath)
             and _md5check(fullpath, md5sum)):
-        _fetch(url, fullpath)
-        if not _md5check(fullpath, md5sum):
-            os.remove(fullpath)
-            raise RuntimeError(f"md5 mismatch for {url}")
+        _fetch(url, fullpath, md5sum)  # verifies md5 pre-cache
     if decompress and os.path.isfile(fullpath) and _is_archive(fullpath):
         return _decompress(fullpath)
     return fullpath

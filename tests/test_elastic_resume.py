@@ -132,3 +132,60 @@ def test_gang_restart_resumes_training(tmp_path):
     assert len(finals) == 2, results
     losses = {ln.split("loss=")[1] for ln in finals}
     assert len(losses) == 1, finals
+
+
+_PREEMPT = """
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fault_tolerance import CheckpointManager
+
+restart = int(os.environ["PADDLE_RESTART_COUNT"])
+root, STEPS = os.environ["PTQ_CKPT_ROOT"], 6
+
+mgr = CheckpointManager(root, save_interval_steps=2, keep=0,
+                        backend="pickle", preemption=True)
+state, start = mgr.restore()
+w = state["w"].numpy() if state is not None else np.zeros(2, np.float32)
+if start:
+    print(f"resumed from step {start}", flush=True)
+for step in range(start + 1, STEPS + 1):
+    w = w + np.float32(step)
+    if step == 3 and restart == 0:
+        # the cloud's preemption notice arrives mid-step
+        os.kill(os.getpid(), __import__("signal").SIGTERM)
+    mgr.step_end(step, {"w": paddle.to_tensor(w)})  # exits 101 when
+print("FINAL", " ".join(f"{v:.1f}" for v in w), flush=True)  # preempted
+sys.stdout.flush()
+os._exit(0)
+"""
+
+
+def test_preemption_exit_101_gets_free_relaunch(tmp_path):
+    """SIGTERM -> final checkpoint -> exit 101 -> ElasticJob respawns
+    WITHOUT burning the restart budget (max_restarts=0 proves it), and
+    the relaunched worker resumes from the preemption checkpoint."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(_PREEMPT))
+    log_dir = tmp_path / "log"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PTQ_CKPT_ROOT"] = str(tmp_path / "ckpt")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--elastic", "--nproc_per_node", "1", "--log_dir", str(log_dir),
+         "--max_restarts", "0", str(script)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    assert "worker requested relaunch (exit 101)" in proc.stderr
+
+    log = (log_dir / "workerlog.0").read_text()
+    # the preemption checkpoint was the last committed step before exit,
+    # and the relaunched generation resumed from it
+    assert "resumed from step 3" in log
+    # trajectory parity: 1+2+...+6 per element, as if never preempted
+    assert "FINAL 21.0 21.0" in log
