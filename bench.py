@@ -29,14 +29,15 @@ def _log(msg):
 
 def _enable_compile_cache():
     """Persistent XLA compilation cache: repeat runs (and driver retries)
-    skip the multi-minute trace+compile of the 1B-param train step. Best
-    effort — the remote-compile tunnel may bypass it."""
+    skip the multi-minute trace+compile of the 1B-param train step. Now
+    lives in the framework (core.compile_cache, FLAGS_tpu_persistent_cache)
+    so tests/examples/tools warm-start too; bench always forces it on.
+    Best effort — the remote-compile tunnel may bypass it."""
     try:
-        cache_dir = os.path.join(_REPO, ".jax_cache")
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        from paddle_tpu.core import compile_cache
+        path = compile_cache.ensure(force=True)
+        if path is None:
+            _log("compilation cache unavailable")
     except Exception as e:
         _log(f"compilation cache unavailable: {e}")
 
@@ -113,6 +114,15 @@ def main():
                 dtype=jnp.bfloat16, budget_s=120, iters=30, verbose=True)
             _log(f"flash blocks: {tuned_blocks} (cache hit is instant; "
                  "a live sweep is budgeted 120s)")
+            # fused decoder-block kernels (the path the step actually
+            # takes on TPU under FLAGS_tpu_fused_blocks=auto): tune
+            # their block shapes too, same cache / budget discipline
+            tuned_fused = pallas_ops.tune_fused_blocks(
+                B=1, S=S, H=base["hidden_size"],
+                D=base["hidden_size"] // base["num_attention_heads"],
+                I=base["intermediate_size"],
+                dtype=jnp.bfloat16, budget_s=120, iters=10, verbose=True)
+            _log(f"fused blocks: {tuned_fused}")
         except Exception as e:
             sys.stderr.write(f"bench: autotune skipped: {e}\n")
 
@@ -217,10 +227,14 @@ def main():
     mfu = 100.0 * flops / dt / _peak_flops(dev)
     tok_per_sec = tokens / dt
 
-    from paddle_tpu.ops import pallas_ops
+    from paddle_tpu.ops import autotune, pallas_ops
     used_flash = pallas_ops.flash_attention_available(
         (B, S, cfg.num_attention_heads,
          cfg.hidden_size // cfg.num_attention_heads))
+    used_fused_attn = on_tpu and pallas_ops.fused_attention_available(
+        (B, S, cfg.hidden_size), cfg.head_dim, cfg.dtype)
+    used_fused_mlp = on_tpu and pallas_ops.fused_mlp_available(
+        (B, S, cfg.hidden_size), cfg.intermediate_size, cfg.dtype)
     result = {
         "metric": "llama_train_mfu_1chip",
         "value": round(mfu, 2),
@@ -235,7 +249,13 @@ def main():
             "attention": "pallas_flash" if used_flash else "xla_jnp",
             "flash_blocks": (list(tuned_blocks)
                              if (tuned_blocks and used_flash) else None),
+            "fused_blocks": {"attention": used_fused_attn,
+                             "mlp": used_fused_mlp},
             "remat_policy": cfg.remat_policy if cfg.use_remat else "none",
+            # what was tuned and how the cache behaved, so BENCH_rNN
+            # records carry the winning configs, not just the MFU
+            "autotune": {"stats": autotune.cache_stats(),
+                         "configs": autotune.entries()},
         },
     }
     # xmem capture (when enabled): the step executable's static HBM peak

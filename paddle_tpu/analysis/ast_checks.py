@@ -20,6 +20,13 @@ mutable-default-arg           warning    list/dict/set literal as a default
 flag-lookup-in-loop           warning    get_flags()/flags.flag()/
                                          os.environ lookups inside a loop —
                                          hoist the read out of the hot path
+mosaic-block-shape            warning    pl.BlockSpec literal whose block
+                                         shape violates Mosaic's tiling rule
+                                         (last dim % 128, second-to-last
+                                         % 8) for every dtype — the
+                                         BENCH_r02 `(1, 256)` launch-failure
+                                         class; legal only if the array dim
+                                         happens to equal the block dim
 ============================  =========  ====================================
 
 The sanctioned host-transfer idiom is an *explicit* ``jax.device_get``
@@ -54,6 +61,9 @@ AST_RULES: Dict[str, tuple] = {
         WARNING, "mutable default argument shared across calls"),
     "flag-lookup-in-loop": (
         WARNING, "flag/env lookup inside a loop body"),
+    "mosaic-block-shape": (
+        WARNING, "pl.BlockSpec block-shape literal that no dtype makes "
+                 "Mosaic-legal (last dim % 128, second-to-last % 8)"),
 }
 
 _SYNC_ATTRS = {"item", "numpy", "tolist"}
@@ -128,6 +138,46 @@ def _swallows(handler: ast.ExceptHandler) -> bool:
     return (len(body) == 1 and isinstance(body[0], ast.Expr)
             and isinstance(body[0].value, ast.Constant)
             and body[0].value.value is Ellipsis)
+
+
+def _blockspec_literal_shape(call: ast.Call) -> Optional[tuple]:
+    """The all-int-literal block shape of a pl.BlockSpec(...) call, or
+    None when it isn't one / the shape isn't fully literal (variables —
+    e.g. autotuned block sizes — can't be judged statically)."""
+    if _func_attr(call.func) != "BlockSpec":
+        return None
+    shape_node = None
+    if call.args:
+        shape_node = call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "block_shape":
+            shape_node = kw.value
+    if not isinstance(shape_node, (ast.Tuple, ast.List)):
+        return None
+    dims = []
+    for elt in shape_node.elts:
+        if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+                and not isinstance(elt.value, bool)):
+            return None
+        dims.append(elt.value)
+    return tuple(dims)
+
+
+def _mosaic_illegal_dims(shape: tuple) -> List[str]:
+    """Which dims of a literal block shape violate Mosaic's divisibility
+    rule for every dtype (mirror of pallas_ops.mosaic_block_legal, minus
+    the block-dim == array-dim escape, which is unknowable statically).
+    rank >= 2: last % 128 and second-to-last % 8; rank 1: % 128 (the
+    f32 tiling — wider-tiled narrow dtypes only raise the bar)."""
+    problems = []
+    if len(shape) >= 2:
+        if shape[-1] % 128:
+            problems.append(f"last dim {shape[-1]} % 128 != 0")
+        if shape[-2] % 8:
+            problems.append(f"second-to-last dim {shape[-2]} % 8 != 0")
+    elif len(shape) == 1 and shape[0] % 128:
+        problems.append(f"dim {shape[0]} % 128 != 0")
+    return problems
 
 
 def _is_flag_lookup(call: ast.Call) -> bool:
@@ -226,6 +276,18 @@ class _Checker(ast.NodeVisitor):
                       "flag/env lookup inside a loop — read it once "
                       "before the loop (per-step dict/env lookups add up "
                       "in hot paths)")
+        shape = _blockspec_literal_shape(node)
+        if shape is not None:
+            problems = _mosaic_illegal_dims(shape)
+            if problems:
+                self._add("mosaic-block-shape", node.lineno,
+                          f"BlockSpec block shape {shape} is Mosaic-"
+                          f"illegal for every dtype ({'; '.join(problems)})"
+                          " unless the array dim happens to equal the "
+                          "block dim — kernels launch-fail at run time "
+                          "(the BENCH_r02 class); derive block sizes "
+                          "from a mosaic_block_legal-filtered candidate "
+                          "set instead")
         self.generic_visit(node)
 
 

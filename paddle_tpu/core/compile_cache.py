@@ -1,0 +1,89 @@
+"""Persistent XLA compilation cache, framework-wide.
+
+Reference analog: paddle/fluid/framework/ir/ + the CINN compilation cache
+directory knobs; on the jax stack this is the built-in persistent
+compilation cache (``jax_compilation_cache_dir``), which keys entries by
+serialized HLO + jaxlib version + device topology — a cache written on one
+toolchain/topology never mis-hits on another.
+
+``ensure()`` turns it on process-wide, idempotently, honoring
+``FLAGS_tpu_persistent_cache``. It is called from every compile chokepoint
+the framework owns — ``profiler/xmem.py::aot_compile`` (the AOT
+``lower().compile()`` path that ``jit/api.py``'s per-signature ``_aot_cache``
+and the Executor/Predictor funnel through), ``bench.py``, and
+``tools/pod_report.py`` — so tests, examples, and tools all get warm starts,
+not just bench.
+
+The cache dir defaults to ``<repo>/.jax_cache`` (the directory bench.py has
+always used, so existing warm caches keep hitting) and can be overridden
+with ``PADDLE_TPU_COMPILE_CACHE_DIR``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["ensure", "cache_dir", "enabled"]
+
+# module state: None = never attempted, str path = active, False = off/failed
+_STATE = None
+
+
+def _repo_root() -> str:
+    # paddle_tpu/core/compile_cache.py -> paddle_tpu/core -> paddle_tpu -> repo
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def cache_dir() -> str:
+    """The directory the persistent cache lives in (whether or not active)."""
+    return os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR") or \
+        os.path.join(_repo_root(), ".jax_cache")
+
+
+def enabled() -> bool:
+    """Is the persistent cache active in this process?"""
+    return isinstance(_STATE, str)
+
+
+def ensure(force: bool = False) -> Optional[str]:
+    """Activate the persistent XLA compilation cache if the flag asks for
+    it. Idempotent and cheap on repeat calls (one module-global check).
+
+    ``force=True`` activates regardless of ``FLAGS_tpu_persistent_cache``
+    (bench.py's behavior since PR 2 — it always wants the cache).
+    Returns the cache dir when active, None otherwise. Best effort: any
+    failure (read-only FS, headless jax) deactivates quietly — a missing
+    cache is a slow start, never an error.
+    """
+    global _STATE
+    if _STATE is not None and not (force and _STATE is False):
+        return _STATE if isinstance(_STATE, str) else None
+    if not force:
+        try:
+            from paddle_tpu.core.flags import flag
+            if not flag("FLAGS_tpu_persistent_cache"):
+                _STATE = False
+                return None
+        except Exception:
+            _STATE = False
+            return None
+    try:
+        import jax
+        path = cache_dir()
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # bench-proven thresholds: skip sub-2s compiles (cache overhead
+        # dominates), keep everything else regardless of size
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _STATE = path
+        return path
+    except Exception:
+        _STATE = False
+        return None
+
+
+def _reset_for_tests():
+    global _STATE
+    _STATE = None

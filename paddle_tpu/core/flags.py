@@ -75,6 +75,21 @@ define_flag("FLAGS_tpu_lint", False,
             "section and lint_findings_total metrics. Off: zero per-call "
             "overhead (the check sits inside the new-signature branch; "
             "its gate is one dict lookup + bool check).")
+define_flag("FLAGS_tpu_fused_blocks", "auto",
+            "Fused decoder-block Pallas kernels (ops.pallas_ops."
+            "fused_attention_block / fused_mlp_block): 'auto' uses them "
+            "on TPU for qualifying shapes and never on CPU (except under "
+            "the Pallas interpreter in tests), 'on' forces the fused "
+            "path wherever the kernels can run, 'off' keeps the unfused "
+            "reference composition everywhere.")
+define_flag("FLAGS_tpu_persistent_cache", False,
+            "Persistent XLA compilation cache for every compile in the "
+            "process: jit/to_static AOT compiles (via profiler.xmem), "
+            "bench.py, examples, tools/pod_report.py. Cache dir defaults "
+            "to <repo>/.jax_cache (override with "
+            "PADDLE_TPU_COMPILE_CACHE_DIR). Warm starts skip XLA "
+            "compilation entirely; safe to leave on — entries are keyed "
+            "by HLO + jaxlib + topology.")
 define_flag("FLAGS_tpu_xmem", False,
             "Capture per-executable memory_analysis()/cost_analysis() "
             "(HBM peaks, temp bytes, flops) at every jit/Executor/"

@@ -66,11 +66,20 @@ class LlamaConfig:
     # "dots" saves matmul outputs and recomputes only elementwise chains
     # (near-zero extra FLOPs — the right default when activations fit)
     remat_policy: str = "dots"
+    # fused decoder-block Pallas kernels (ops.pallas_ops
+    # fused_attention_block / fused_mlp_block): None follows
+    # FLAGS_tpu_fused_blocks; "auto" = TPU-only, "on" = wherever the
+    # kernels can run (incl. the interpreter — what parity tests use),
+    # "off" = always the unfused composition
+    fused_blocks: Any = None
 
     def __post_init__(self):
         assert self.remat_policy in ("full", "dots"), \
             f"remat_policy must be 'full' or 'dots', got " \
             f"{self.remat_policy!r}"
+        assert self.fused_blocks in (None, "auto", "on", "off"), \
+            f"fused_blocks must be None, 'auto', 'on' or 'off', got " \
+            f"{self.fused_blocks!r}"
 
     @property
     def head_dim(self):
@@ -291,16 +300,61 @@ def _moe_mlp(cfg: LlamaConfig, lp, x):
     return out.reshape(B, S, H), aux
 
 
+def _fused_block_modes(cfg: LlamaConfig, x, cp_mesh, cp_axis_level):
+    """(use_fused_attention, use_fused_mlp) — resolved at trace time from
+    the policy (cfg.fused_blocks, else FLAGS_tpu_fused_blocks) and shape
+    eligibility. "auto" engages only on real TPU (never the CPU jnp path
+    a test traces); "on" engages wherever the kernels can run, including
+    the Pallas interpreter — which is how parity tests exercise this."""
+    from ..ops import pallas_ops
+    mode = cfg.fused_blocks
+    if mode is None:
+        try:
+            from ..core.flags import flag
+            mode = flag("FLAGS_tpu_fused_blocks")
+        except Exception:
+            mode = "auto"
+    if mode == "off":
+        return False, False
+    if mode == "auto" and not pallas_ops._on_tpu():
+        return False, False
+    attn_ok = (cp_mesh is None and not cp_axis_level
+               and cfg.num_key_value_heads == cfg.num_attention_heads
+               and pallas_ops.fused_attention_available(
+                   x.shape, cfg.head_dim, x.dtype))
+    mlp_ok = (cfg.moe_num_experts == 0
+              and pallas_ops.fused_mlp_available(
+                  x.shape, cfg.intermediate_size, x.dtype))
+    return attn_ok, mlp_ok
+
+
 def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
                   cp_axis="sp", cp_axis_level=False):
     """One decoder block on a per-layer param slice (no leading L axis)."""
-    h = x + _attention(cfg, lp, _rms_norm(x, lp["ln1"], cfg.rms_norm_eps),
-                       sin, cos, cp_mesh=cp_mesh, cp_axis=cp_axis,
-                       cp_axis_level=cp_axis_level)
-    normed = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+    from ..ops import pallas_ops
+    fused_attn, fused_mlp = _fused_block_modes(cfg, x, cp_mesh,
+                                               cp_axis_level)
+    if fused_attn:
+        # norm + qkv + rope + flash + wo + residual in two Pallas kernels
+        h = pallas_ops.fused_attention_block(
+            x, lp["ln1"], lp["wq"], lp["wk"], lp["wv"], lp["wo"],
+            sin, cos, head_dim=cfg.head_dim, eps=cfg.rms_norm_eps)
+    else:
+        h = x + _attention(cfg, lp,
+                           _rms_norm(x, lp["ln1"], cfg.rms_norm_eps),
+                           sin, cos, cp_mesh=cp_mesh, cp_axis=cp_axis,
+                           cp_axis_level=cp_axis_level)
     if cfg.moe_num_experts > 0:
-        mlp_out, aux = _moe_mlp(cfg, lp, normed)
+        mlp_out, aux = _moe_mlp(cfg, lp,
+                                _rms_norm(h, lp["ln2"], cfg.rms_norm_eps))
         return h + mlp_out, aux
+    if fused_mlp:
+        # norm + gate/up + silu + down + residual in one Pallas kernel
+        out = pallas_ops.fused_mlp_block(
+            h, lp["ln2"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            eps=cfg.rms_norm_eps)
+        return out, jnp.zeros((), jnp.float32)
+    normed = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
     return h + _dense_mlp(lp, normed), jnp.zeros((), jnp.float32)
 
 

@@ -27,8 +27,9 @@ import time
 
 import numpy as np
 
-__all__ = ["set_config", "enabled", "lookup", "record", "tune",
-           "save", "load", "time_callable", "cache_stats"]
+__all__ = ["set_config", "enabled", "lookup", "lookup_chain", "record",
+           "tune", "save", "load", "time_callable", "cache_stats",
+           "context_key", "legal_candidates", "entries", "summary_lines"]
 
 # op_name -> {key(str): config(list|tuple)}
 _CACHE: dict = {}
@@ -70,6 +71,27 @@ def _key_str(key) -> str:
     return json.dumps(key, default=str) if not isinstance(key, str) else key
 
 
+def context_key(dtype_str=None):
+    """The execution-context suffix every new cache key carries:
+    ``[dtype, device_kind, jaxlib_version]``. A cache tuned for bf16 on a
+    v5e with one jaxlib never mis-seeds an f32 run, another topology, or
+    a toolchain with different Mosaic lowering (each context tunes its
+    own entry; `lookup_chain` still falls back to older key layouts)."""
+    if dtype_str is None:
+        dtype_str = "unknown"
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    try:
+        import jaxlib
+        ver = jaxlib.__version__
+    except Exception:
+        ver = "unknown"
+    return [str(dtype_str), str(kind), str(ver)]
+
+
 def lookup(op_name: str, key):
     global _HITS, _MISSES
     cfg = _CACHE.get(op_name, {}).get(_key_str(key))
@@ -80,9 +102,51 @@ def lookup(op_name: str, key):
     return tuple(cfg) if isinstance(cfg, list) else cfg
 
 
+def lookup_chain(op_name: str, keys):
+    """Try ``keys`` most-specific-first; first hit wins. Counts exactly
+    one hit or one miss total (not one per fallback probe), so the
+    hit/miss gauges reflect op-level cache effectiveness."""
+    global _HITS, _MISSES
+    table = _CACHE.get(op_name, {})
+    for key in keys:
+        cfg = table.get(_key_str(key))
+        if cfg is not None:
+            _HITS += 1
+            return tuple(cfg) if isinstance(cfg, list) else cfg
+    _MISSES += 1
+    return None
+
+
+def legal_candidates(pool, spec_fn, dtype_bits=32):
+    """Filter a candidate ``pool`` down to configs whose every BlockSpec
+    is Mosaic-legal — the only path by which block-shape candidates enter
+    a tuning search, making illegal shapes unrepresentable by
+    construction (BENCH_r02's `(1, 256)` class of launch failure).
+
+    ``spec_fn(candidate)`` returns the candidate's full list of
+    ``(block_shape, array_shape)`` pairs, or None to disqualify it
+    outright (shape mismatch, VMEM budget, ...). Every pair must satisfy
+    ``pallas_ops.mosaic_block_legal`` at ``dtype_bits`` for the candidate
+    to survive. Preserves pool order; deduplicates."""
+    from paddle_tpu.ops.pallas_ops import mosaic_block_legal
+    out, seen = [], set()
+    for cand in pool:
+        if cand in seen:
+            continue
+        seen.add(cand)
+        pairs = spec_fn(cand)
+        if pairs is None:
+            continue
+        if all(mosaic_block_legal(tuple(b), tuple(a), dtype_bits=dtype_bits)
+               for b, a in pairs):
+            out.append(cand)
+    return out
+
+
 def record(op_name: str, key, config):
     _CACHE.setdefault(op_name, {})[_key_str(key)] = (
         list(config) if isinstance(config, tuple) else config)
+    _publish_metrics(op_name, key, config)
     path = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
     if path:
         try:
@@ -91,14 +155,78 @@ def record(op_name: str, key, config):
             pass
 
 
+def _publish_metrics(op_name=None, key=None, config=None):
+    """Mirror cache state into the metrics registry (no-op when metrics
+    are off): hit/miss/size gauges plus a per-entry chosen-config gauge
+    family, so exported snapshots show *what* was tuned."""
+    try:
+        from paddle_tpu.profiler import metrics
+    except ImportError:
+        return
+    if not metrics.enabled():
+        return
+    stats = cache_stats()
+    metrics.gauge("autotune_cache_entries",
+                  "Tuned configs in the autotune cache").set(stats["size"])
+    metrics.gauge("autotune_cache_hits",
+                  "Autotune cache hits (trace-time lookups)"
+                  ).set(stats["hits"])
+    metrics.gauge("autotune_cache_misses",
+                  "Autotune cache misses").set(stats["misses"])
+    if op_name is not None and config is not None:
+        label = f"{op_name}|{_key_str(key)}"[:120]
+        for i, v in enumerate(config if isinstance(config, (list, tuple))
+                              else [config]):
+            try:
+                metrics.gauge("autotune_chosen_config",
+                              "Chosen block config component",
+                              op=label, dim=str(i)).set(float(v))
+            except (TypeError, ValueError):
+                continue
+
+
 def cache_stats():
     n = sum(len(v) for v in _CACHE.values())
     return {"size": n, "hits": _HITS, "misses": _MISSES}
 
 
+def entries():
+    """Deep copy of the cache: {op: {key_str: config}} — for bench JSON
+    detail and the Profiler section."""
+    return {op: dict(table) for op, table in _CACHE.items()}
+
+
+def summary_lines():
+    """Autotune section for Profiler.summary_table()."""
+    stats = cache_stats()
+    lines = ["Autotune",
+             f"  cache entries: {stats['size']}  "
+             f"hits: {stats['hits']}  misses: {stats['misses']}"]
+    for op in sorted(_CACHE):
+        for key_str, cfg in sorted(_CACHE[op].items()):
+            lines.append(f"  {op} {key_str} -> {cfg}")
+    return lines
+
+
 def save(path: str):
+    """Persist the cache, MERGING with what's already on disk: entries
+    for ops/keys not re-tuned in this process survive. (A clobbering
+    save after a partial `load()` used to silently drop every entry the
+    process never touched.) In-memory entries win on key conflicts."""
+    merged: dict = {}
+    try:
+        with open(path) as f:
+            on_disk = json.load(f)
+        if isinstance(on_disk, dict):
+            for op_name, table in on_disk.items():
+                if isinstance(table, dict):
+                    merged[op_name] = dict(table)
+    except (OSError, ValueError):
+        pass
+    for op_name, table in _CACHE.items():
+        merged.setdefault(op_name, {}).update(table)
     with open(path, "w") as f:
-        json.dump(_CACHE, f, indent=1, sort_keys=True)
+        json.dump(merged, f, indent=1, sort_keys=True)
 
 
 def load(path: str):

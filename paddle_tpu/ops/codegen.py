@@ -30,7 +30,8 @@ import jax
 
 from .registry import OP_LIBRARY
 
-__all__ = ["export_manifest", "fast_op", "parity_cases"]
+__all__ = ["export_manifest", "fast_op", "parity_cases",
+           "fused_parity_cases"]
 
 
 def _signature(fn: Callable) -> str:
@@ -174,3 +175,13 @@ def parity_cases() -> List[Tuple[str, Callable, Callable, int]]:
         if n_params in (1, 2):
             cases.append((name, lowering, np_fn, n_params))
     return cases
+
+
+def fused_parity_cases():
+    """(name, fused_fn, reference_fn, make_args) for the fused decoder-
+    block Pallas kernels (ops.pallas_ops) — the structured counterpart of
+    parity_cases() for ops whose reference is a jnp composition rather
+    than a numpy ufunc. tests/test_pallas_fused.py sweeps these fwd+bwd
+    under the Pallas interpreter."""
+    from paddle_tpu.ops.pallas_ops import fused_parity_cases as _cases
+    return _cases()

@@ -51,19 +51,36 @@ from jax import lax
 
 __all__ = ["causal_attention", "flash_attention_available",
            "mosaic_block_legal", "flash_block_specs",
-           "tune_causal_attention"]
+           "tune_causal_attention", "flash_candidates",
+           "fused_attention_block", "fused_mlp_block",
+           "fused_attention_available", "fused_mlp_available",
+           "fused_attn_block_specs", "fused_mlp_block_specs",
+           "fused_attn_candidates", "fused_mlp_candidates",
+           "tune_fused_blocks", "fused_parity_cases"]
 
 _BQ = 256
 _BK = 256
 _LANES = 128  # TPU lane width; row stats are replicated across it
 
-# (bq, bk) candidates the autotuner may select from (paddle's
-# phi/kernels/autotune exhaustive search analog, over Mosaic-legal block
-# shapes). All are multiples of 8x128 so every derived BlockSpec stays
-# legal; candidates not dividing S are filtered per shape.
+# Block-size axis values the candidate generators draw from. Every value
+# is a multiple of both the 128-lane tile and the 8-sublane tile, so the
+# raw pool can only produce Mosaic-aligned dims; the generators then
+# validate every derived BlockSpec with mosaic_block_legal before a
+# candidate becomes visible (illegal shapes are unrepresentable — the
+# BENCH_r02 (1, 256) failure class cannot be emitted).
+_POW2_BLOCKS = (128, 256, 512, 1024)
+
+# Legacy static (bq, bk) pool, kept as the seed ordering for
+# flash_candidates (preference order: measured-good defaults first).
 _BLOCK_CANDIDATES = ((256, 256), (512, 512), (512, 256), (256, 512),
                      (128, 256), (256, 128), (1024, 512), (512, 1024),
                      (128, 128), (1024, 1024))
+
+# VMEM working-set ceiling for candidate generation (16MB parts, minus
+# headroom for Mosaic's own spills). Candidates whose resident blocks +
+# scratch exceed it are disqualified up front instead of failing at
+# compile time inside the tuning loop.
+_VMEM_BUDGET = 12 * 2 ** 20
 
 # Flip to True to force the Pallas path through the interpreter (CPU tests).
 _INTERPRET = False
@@ -95,21 +112,54 @@ def _blocks_legal(bq, bk, S, D):
                for blk, arr in groups[io])
 
 
+def _flash_keys(S, D, dtype=None):
+    """Cache-key chain for the flash (bq, bk) entry, most-specific first:
+    the full context key (dtype + device kind + jaxlib version — a
+    v5e-tuned cache never mis-seeds another topology or toolchain), then
+    the legacy dtype-only key (committed caches), then the legacy
+    any-dtype key (pre-dtype caches)."""
+    from paddle_tpu.ops import autotune
+    keys = []
+    if dtype is not None:
+        dstr = str(jnp.dtype(dtype))
+        keys.append(["blocks", int(S), int(D)] + autotune.context_key(dstr))
+        keys.append(["blocks", int(S), int(D), dstr])
+    keys.append(["blocks", int(S), int(D)])
+    return keys
+
+
 def _block_config(S, D, dtype=None):
     """Active (bq, bk) for a given sequence/head-dim/dtype: the autotuned
     winner if one is cached (see tune_causal_attention), else the 256x256
     default. Read at trace time, so jitted graphs bake in the choice."""
     from paddle_tpu.ops import autotune
-    cfg = None
-    if dtype is not None:
-        cfg = autotune.lookup(
-            "flash_attention",
-            ["blocks", int(S), int(D), str(jnp.dtype(dtype))])
-    if cfg is None:  # any-dtype fallback entry (pre-dtype caches)
-        cfg = autotune.lookup("flash_attention", ["blocks", int(S), int(D)])
+    cfg = autotune.lookup_chain("flash_attention", _flash_keys(S, D, dtype))
     if cfg is not None and _blocks_legal(int(cfg[0]), int(cfg[1]), S, D):
         return int(cfg[0]), int(cfg[1])
     return _BQ, _BK
+
+
+def flash_candidates(S, D, dtype=jnp.float32):
+    """Legal-by-construction (bq, bk) candidates for the flash kernels at
+    this shape: the static preference pool plus the power-of-two grid,
+    filtered through autotune.legal_candidates so every derived BlockSpec
+    passes mosaic_block_legal (and tiles S). The tuner can only ever
+    measure configs that compile."""
+    from paddle_tpu.ops import autotune
+    pool = list(_BLOCK_CANDIDATES) + [
+        (bq, bk) for bq in _POW2_BLOCKS for bk in _POW2_BLOCKS
+        if (bq, bk) not in _BLOCK_CANDIDATES]
+
+    def spec_fn(cand):
+        bq, bk = cand
+        if S % bq or S % bk or S < bq or bk % _LANES:
+            return None
+        specs = flash_block_specs(8, S, D, bq, bk)
+        return [pair for groups in specs.values()
+                for io in ("in", "out") for pair in groups[io]]
+
+    bits = 8 * jnp.dtype(dtype).itemsize
+    return autotune.legal_candidates(pool, spec_fn, dtype_bits=bits)
 
 
 def flash_attention_available(q_shape, dtype=None):
@@ -209,10 +259,16 @@ def _rep_lanes(col, n_lanes):
     return t if reps == 1 else jnp.tile(t, (1, reps))
 
 
-def _compiler_params():
+def _compiler_params(*dimension_semantics):
+    # jaxlib <= 0.4.x spells it TPUCompilerParams; the rename to
+    # CompilerParams landed later. Probe both so the streamed kernels
+    # compile on either toolchain.
     from jax.experimental.pallas import tpu as pltpu
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    cls = getattr(pltpu, "TPUCompilerParams", None) or \
+        getattr(pltpu, "CompilerParams")
+    if not dimension_semantics:
+        dimension_semantics = ("parallel", "parallel", "arbitrary")
+    return cls(dimension_semantics=tuple(dimension_semantics))
 
 
 # ---------------------------------------------------------------------------
@@ -727,8 +783,12 @@ def tune_causal_attention(B, S, H, D, dtype=jnp.bfloat16, budget_s=None,
     from paddle_tpu.ops import autotune
 
     dtype = jnp.dtype(dtype)
-    key = ["blocks", int(S), int(D), str(dtype)]
-    cached = autotune.lookup("flash_attention", key)
+    # new entries are recorded under the full context key; the cached
+    # check walks the legacy chain too so committed shape-only caches
+    # still short-circuit the sweep
+    key = ["blocks", int(S), int(D)] + autotune.context_key(str(dtype))
+    cached = autotune.lookup_chain("flash_attention",
+                                   _flash_keys(S, D, dtype))
     if cached is not None:
         return tuple(cached)
     if not (_on_tpu() or _INTERPRET):
@@ -770,5 +830,836 @@ def tune_causal_attention(B, S, H, D, dtype=jnp.bfloat16, budget_s=None,
             reps.append(_time.perf_counter() - t0)
         return min(reps) / n_chain
 
-    return autotune.tune("flash_attention", key, _BLOCK_CANDIDATES,
+    return autotune.tune("flash_attention", key,
+                         flash_candidates(S, D, dtype),
                          time_candidate, budget_s=budget_s, verbose=verbose)
+
+
+# ===========================================================================
+# Fused decoder-block kernels
+# ===========================================================================
+#
+# The llama decoder layer's hot path, fused into persistent Pallas kernels
+# (MPK / Neptune-style block-level fusion — the RMSNorm / RoPE /
+# projection / residual glue that XLA otherwise runs as separate fusions
+# between kernel launches moves inside the kernels):
+#
+#   fused_attention_block:  y = x + attn(rope(rms(x)@wq), rope(rms(x)@wk),
+#                                        rms(x)@wv) @ wo
+#     Kernel A (_qkv_fused_kernel): RMSNorm (once per sequence block, in
+#       VMEM scratch) + the three projections + RoPE — grid (B, S/bq, nh),
+#       writing q/k/v in flattened [B, S, nh*D] layout so the flash stage
+#       reads head slices without a transpose.
+#     Kernel B (_attn_epi_kernel): resident flash attention per head +
+#       the wo output projection and residual add in the epilogue — grid
+#       (B, S/bq, nh) with the HEAD axis innermost, accumulating
+#       attn_h @ wo[hD:(h+1)D, :] into a [bq, H] VMEM scratch that is
+#       flushed (with the residual) when the last head finishes. The
+#       head-innermost order keeps every revisit of the y output block on
+#       consecutive grid steps, which is Mosaic's revisiting rule.
+#     Backward: the O(S^2) core reuses the *verified* resident flash
+#       backward kernel bodies unchanged, re-indexed over the flattened
+#       layout (index maps slice heads: (bh//nh, i, bh%nh)); the
+#       prologue/epilogue weight grads are jnp (pure MXU matmuls XLA
+#       already runs at peak — the fusion win is the elementwise glue
+#       and launch overhead, not the GEMMs).
+#
+#   fused_mlp_block:  y = x + (silu(rms(x)@wg) * (rms(x)@wu)) @ wd
+#     One forward kernel, grid (B, S/bs, I/bi) with the INTERMEDIATE axis
+#     innermost: RMSNorm once into scratch, then per intermediate block
+#     gate/up matmul + SiLU + down-projection partial accumulated in a
+#     [bs, H] scratch, residual added at the flush. Backward: a fused dx
+#     kernel (recomputes gate/up per block, accumulates dxn, applies the
+#     RMSNorm backward + residual in the epilogue) + jnp weight grads.
+#
+# RoPE inside a kernel: rotate_half needs a concat of two 64-lane slices,
+# which Mosaic's lane tiling dislikes; instead the rotation is applied as
+# a matmul against the constant +/-1 permutation matrix R (rot(x) = x @ R)
+# built from iotas — MXU-friendly, exact (entries are 0/+-1), and
+# guaranteed to lower.
+#
+# Both ops carry a custom_vjp with the jnp composition as the reference
+# (and the fallback path when shapes/policy disqualify the kernels), and
+# run under the Pallas interpreter on CPU — tier-1 checks fwd+bwd parity
+# without hardware.
+
+
+def _rms_norm_ref(x, w, eps):
+    # mirrors models/llama.py::_rms_norm exactly (fp32 norm, cast to the
+    # activation dtype BEFORE the weight multiply)
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(ms + eps)).astype(x.dtype) * w
+
+
+def _rope_flat(x, sin, cos, D):
+    """RoPE (neox rotate-half) over flattened-head [B, S, nh*D] layout —
+    mirrors models/llama.py::_apply_rope per head."""
+    B, S, H = x.shape
+    xh = x.reshape(B, S, H // D, D)
+    half = D // 2
+    x1, x2 = xh[..., :half], xh[..., half:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    sin_ = sin[None, :, None, :].astype(x.dtype)
+    cos_ = cos[None, :, None, :].astype(x.dtype)
+    return (xh * cos_ + rot * sin_).reshape(B, S, H)
+
+
+def _attention_block_jnp(x, ln, wq, wk, wv, wo, sin, cos, head_dim, eps):
+    """jnp reference for fused_attention_block — the exact op sequence of
+    the unfused decoder-layer attention sub-block (rmsnorm -> qkv -> rope
+    -> causal attention -> wo -> residual)."""
+    xn = _rms_norm_ref(x, ln, eps)
+    q = _rope_flat(xn @ wq, sin, cos, head_dim)
+    k = _rope_flat(xn @ wk, sin, cos, head_dim)
+    v = xn @ wv
+    B, S, H = x.shape
+    nh = H // head_dim
+    attn = _attention_jnp(q.reshape(B, S, nh, head_dim),
+                          k.reshape(B, S, nh, head_dim),
+                          v.reshape(B, S, nh, head_dim))
+    return x + attn.reshape(B, S, H) @ wo
+
+
+def _mlp_block_jnp(x, ln, wg, wu, wd, eps):
+    """jnp reference for fused_mlp_block — the exact op sequence of the
+    unfused decoder-layer MLP sub-block."""
+    xn = _rms_norm_ref(x, ln, eps)
+    return x + (jax.nn.silu(xn @ wg) * (xn @ wu)) @ wd
+
+
+def fused_attn_block_specs(B, S, H, D, bq, bk):
+    """(block_shape, array_shape) for every HBM operand of the fused
+    attention block's kernels — consumed by the pallas_calls below, the
+    candidate generator, and the shape unit tests."""
+    nh = H // D
+    xblk = ((1, bq, H), (B, S, H))
+    headblk = ((1, bq, D), (B, S, H))
+    headfull = ((1, S, D), (B, S, H))
+    lse = ((1, 1, bq, _LANES), (B, nh, S, _LANES))
+    lse_flat = ((1, bq, _LANES), (B * nh, S, _LANES))
+    lse_flat_full = ((1, S, _LANES), (B * nh, S, _LANES))
+    return {
+        "qkv": {"in": [xblk, ((1, H), (1, H)),
+                       ((H, D), (H, H)), ((H, D), (H, H)), ((H, D), (H, H)),
+                       ((bq, D), (S, D)), ((bq, D), (S, D))],
+                "out": [headblk, headblk, headblk]},
+        "attn": {"in": [headblk, headfull, headfull, xblk, ((D, H), (H, H))],
+                 "out": [xblk, headblk, lse]},
+        "bwd_dq": {"in": [headblk, headfull, headfull, headblk, headblk,
+                          lse_flat],
+                   "out": [headblk]},
+        "bwd_dkv": {"in": [headfull, ((1, bk, D), (B, S, H)),
+                           ((1, bk, D), (B, S, H)), headfull, headfull,
+                           lse_flat_full],
+                    "out": [((1, bk, D), (B, S, H)),
+                            ((1, bk, D), (B, S, H))]},
+    }
+
+
+def fused_mlp_block_specs(B, S, H, I, bs, bi):
+    """(block_shape, array_shape) for the fused MLP kernels' operands."""
+    xblk = ((1, bs, H), (B, S, H))
+    return {
+        "fwd": {"in": [xblk, ((1, H), (1, H)), ((H, bi), (H, I)),
+                       ((H, bi), (H, I)), ((bi, H), (I, H))],
+                "out": [xblk]},
+        "bwd_dx": {"in": [xblk, ((1, H), (1, H)), ((H, bi), (H, I)),
+                          ((H, bi), (H, I)), ((bi, H), (I, H)), xblk],
+                   "out": [xblk]},
+    }
+
+
+def fused_attn_candidates(B, S, H, D, dtype=jnp.float32):
+    """Legal-by-construction (bq, bk) candidates for the fused attention
+    block: Mosaic-legal BlockSpecs (via mosaic_block_legal) AND the VMEM
+    working set (resident k/v head, wo slice, x/y blocks, the [bq, H]
+    epilogue accumulator) within budget."""
+    from paddle_tpu.ops import autotune
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def spec_fn(cand):
+        bq, bk = cand
+        if S % bq or S % bk or S < bq or bk % _LANES or H % D:
+            return None
+        vmem = (2 * S * D * itemsize        # resident k/v for this head
+                + 3 * bq * H * itemsize     # x, y, (attn out rows)
+                + D * H * itemsize          # wo slice
+                + bq * H * 4                # f32 epilogue accumulator
+                + bq * H * 4)               # f32 rmsnorm scratch (kernel A)
+        if vmem > _VMEM_BUDGET:
+            return None
+        specs = fused_attn_block_specs(8, S, H, D, bq, bk)
+        return [pair for groups in specs.values()
+                for io in ("in", "out") for pair in groups[io]]
+
+    pool = [(bq, bk) for bq in _POW2_BLOCKS for bk in _POW2_BLOCKS]
+    bits = 8 * itemsize
+    return autotune.legal_candidates(pool, spec_fn, dtype_bits=bits)
+
+
+def fused_mlp_candidates(B, S, H, I, dtype=jnp.float32):
+    """Legal-by-construction (bs, bi) candidates for the fused MLP block."""
+    from paddle_tpu.ops import autotune
+    itemsize = jnp.dtype(dtype).itemsize
+
+    def spec_fn(cand):
+        bs, bi = cand
+        if S % bs or I % bi or S < bs or bi % _LANES:
+            return None
+        vmem = (2 * H * bi * itemsize       # wg, wu blocks
+                + bi * H * itemsize         # wd block
+                + 3 * bs * H * itemsize     # x, y/dy blocks
+                + 2 * bs * H * 4            # f32 xn + accumulator scratch
+                + 2 * bs * bi * 4)          # f32 gate/up intermediates
+        if vmem > _VMEM_BUDGET:
+            return None
+        specs = fused_mlp_block_specs(8, S, H, I, bs, bi)
+        return [pair for groups in specs.values()
+                for io in ("in", "out") for pair in groups[io]]
+
+    pool = [(bs, bi) for bs in _POW2_BLOCKS for bi in _POW2_BLOCKS]
+    bits = 8 * itemsize
+    return autotune.legal_candidates(pool, spec_fn, dtype_bits=bits)
+
+
+def _fused_attn_config(S, H, D, dtype=None):
+    """Active (bq, bk) for the fused attention block: the tuned winner
+    when cached and still legal, else the first legal candidate, else
+    None (shape disqualified)."""
+    from paddle_tpu.ops import autotune
+    cands = fused_attn_candidates(1, S, H, D, dtype or jnp.float32)
+    if not cands:
+        return None
+    key = ["blocks", int(S), int(H), int(D)] + autotune.context_key(
+        str(jnp.dtype(dtype)) if dtype is not None else None)
+    cfg = autotune.lookup_chain("fused_attention", [key])
+    if cfg is not None and tuple(int(c) for c in cfg) in cands:
+        return tuple(int(c) for c in cfg)
+    return cands[0]
+
+
+def _fused_mlp_config(S, H, I, dtype=None):
+    """Active (bs, bi) for the fused MLP block (same contract as
+    _fused_attn_config)."""
+    from paddle_tpu.ops import autotune
+    cands = fused_mlp_candidates(1, S, H, I, dtype or jnp.float32)
+    if not cands:
+        return None
+    key = ["blocks", int(S), int(H), int(I)] + autotune.context_key(
+        str(jnp.dtype(dtype)) if dtype is not None else None)
+    cfg = autotune.lookup_chain("fused_mlp", [key])
+    if cfg is not None and tuple(int(c) for c in cfg) in cands:
+        return tuple(int(c) for c in cfg)
+    return cands[0]
+
+
+def fused_attention_available(x_shape, head_dim, dtype=None):
+    """Can the fused attention block run as Pallas kernels here?"""
+    if _DISABLE or not (_on_tpu() or _INTERPRET):
+        return False
+    B, S, H = x_shape
+    D = head_dim
+    if H % D or D % 128:
+        return False
+    itemsize = jnp.dtype(dtype).itemsize if dtype is not None else 2
+    if not _use_resident(S, D, itemsize):  # epilogue kernel is resident-only
+        return False
+    return _fused_attn_config(S, H, D, dtype) is not None
+
+
+def fused_mlp_available(x_shape, inter_size, dtype=None):
+    """Can the fused MLP block run as a Pallas kernel here?"""
+    if _DISABLE or not (_on_tpu() or _INTERPRET):
+        return False
+    B, S, H = x_shape
+    return _fused_mlp_config(S, H, inter_size, dtype) is not None
+
+
+def _rot_matrix(D, dtype):
+    """The rotate-half permutation as a [D, D] +/-1 matrix: x @ R ==
+    concat(-x2, x1). Built from iotas so it materializes inside the
+    kernel (no lane-dim concat, which Mosaic's tiling rejects)."""
+    half = D // 2
+    ii = lax.broadcasted_iota(jnp.int32, (D, D), 0)
+    jj = lax.broadcasted_iota(jnp.int32, (D, D), 1)
+    return (ii == jj - half).astype(dtype) - (ii == jj + half).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# fused attention: kernel A — RMSNorm + qkv projections + RoPE
+# ---------------------------------------------------------------------------
+
+def _qkv_fused_kernel(x_ref, ln_ref, wq_ref, wk_ref, wv_ref, sin_ref,
+                      cos_ref, q_ref, k_ref, v_ref, xn_s, *, eps):
+    from jax.experimental import pallas as pl
+    h = pl.program_id(2)
+
+    @pl.when(h == 0)
+    def _norm():
+        x32 = x_ref[0].astype(jnp.float32)
+        ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        xn = (x32 * lax.rsqrt(ms + eps)).astype(x_ref.dtype) * ln_ref[...]
+        xn_s[...] = xn.astype(jnp.float32)
+
+    dt = q_ref.dtype
+    xn = xn_s[...].astype(dt)
+    D = q_ref.shape[-1]
+    rot_m = _rot_matrix(D, dt)
+    sin = sin_ref[...].astype(dt)
+    cos = cos_ref[...].astype(dt)
+
+    def proj(w_ref):
+        return lax.dot(xn, w_ref[...],
+                       preferred_element_type=jnp.float32).astype(dt)
+
+    def rope(t):
+        rot = lax.dot(t, rot_m, preferred_element_type=jnp.float32).astype(dt)
+        return t * cos + rot * sin
+
+    q_ref[0] = rope(proj(wq_ref))
+    k_ref[0] = rope(proj(wk_ref))
+    v_ref[0] = proj(wv_ref)
+
+
+def _fused_qkv_proj(x, ln2d, wq, wk, wv, sin, cos, D, bq, eps):
+    """x [B,S,H] -> q, k, v [B,S,H] (flattened heads, RoPE applied)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    B, S, H = x.shape
+    nh = H // D
+    specs = fused_attn_block_specs(B, S, H, D, bq, bq)["qkv"]
+    by_x = lambda b, i, h: (b, i, 0)      # noqa: E731
+    by_ln = lambda b, i, h: (0, 0)        # noqa: E731
+    by_w = lambda b, i, h: (0, h)         # noqa: E731
+    by_rope = lambda b, i, h: (i, 0)      # noqa: E731
+    by_head = lambda b, i, h: (b, i, h)   # noqa: E731
+    out_sds = jax.ShapeDtypeStruct((B, S, H), x.dtype)
+    return pl.pallas_call(
+        functools.partial(_qkv_fused_kernel, eps=eps),
+        out_shape=(out_sds, out_sds, out_sds),
+        grid=(B, S // bq, nh),
+        in_specs=[
+            pl.BlockSpec(specs["in"][0][0], by_x),
+            pl.BlockSpec(specs["in"][1][0], by_ln),
+            pl.BlockSpec(specs["in"][2][0], by_w),
+            pl.BlockSpec(specs["in"][3][0], by_w),
+            pl.BlockSpec(specs["in"][4][0], by_w),
+            pl.BlockSpec(specs["in"][5][0], by_rope),
+            pl.BlockSpec(specs["in"][6][0], by_rope),
+        ],
+        out_specs=tuple(pl.BlockSpec(s[0], by_head) for s in specs["out"]),
+        scratch_shapes=[pltpu.VMEM((bq, H), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
+        interpret=_INTERPRET,
+    )(x, ln2d, wq, wk, wv, sin, cos)
+
+
+# ---------------------------------------------------------------------------
+# fused attention: kernel B — resident flash + wo projection + residual
+# ---------------------------------------------------------------------------
+
+def _attn_epi_kernel(q_ref, k_ref, v_ref, x_ref, wo_ref, y_ref, attn_ref,
+                     lse_ref, acc_s, *, bq, bk, scale):
+    from jax.experimental import pallas as pl
+    qi = pl.program_id(1)
+    h = pl.program_id(2)
+    nh = pl.num_programs(2)
+    q = q_ref[0].astype(jnp.float32)          # [bq, D]
+    D = q.shape[-1]
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    n_kblocks = (qi * bq + bq + bk - 1) // bk  # causal: skip fully-masked
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * bk, bk), :].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        k_pos = i * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1)[:, None])
+        p = jnp.exp(s - _rep_lanes(m_new[:, :1], bk))
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)[:, None]
+        acc_new = acc * _rep_lanes(corr[:, :1], D) + lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, _LANES), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, _LANES), jnp.float32)
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
+    attn = (acc / _rep_lanes(l[:, :1], D)).astype(attn_ref.dtype)
+    attn_ref[0] = attn
+    lse_ref[0, 0] = m + jnp.log(l)
+
+    # epilogue: y = x + sum_h attn_h @ wo[h*D:(h+1)*D, :], accumulated in
+    # f32 scratch across the (innermost) head axis
+    @pl.when(h == 0)
+    def _init():
+        acc_s[...] = x_ref[0].astype(jnp.float32)
+
+    acc_s[...] = acc_s[...] + lax.dot(attn, wo_ref[...],
+                                      preferred_element_type=jnp.float32)
+
+    @pl.when(h == nh - 1)
+    def _flush():
+        y_ref[0] = acc_s[...].astype(y_ref.dtype)
+
+
+def _fused_attn_epilogue(qb, kb, vb, x, wo, D, bq, bk):
+    """Flash attention over flattened heads + wo/residual epilogue.
+    Returns (y [B,S,H], attn [B,S,H] pre-projection, lse [B,nh,S,128])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    B, S, H = x.shape
+    nh = H // D
+    scale = 1.0 / math.sqrt(D)
+    specs = fused_attn_block_specs(B, S, H, D, bq, bk)["attn"]
+    by_head = lambda b, i, h: (b, i, h)   # noqa: E731
+    by_full = lambda b, i, h: (b, 0, h)   # noqa: E731
+    by_x = lambda b, i, h: (b, i, 0)      # noqa: E731
+    by_wo = lambda b, i, h: (h, 0)        # noqa: E731
+    by_lse = lambda b, i, h: (b, h, i, 0)  # noqa: E731
+    return pl.pallas_call(
+        functools.partial(_attn_epi_kernel, bq=bq, bk=bk, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((B, S, H), x.dtype),
+                   jax.ShapeDtypeStruct((B, S, H), x.dtype),
+                   jax.ShapeDtypeStruct((B, nh, S, _LANES), jnp.float32)),
+        grid=(B, S // bq, nh),
+        in_specs=[
+            pl.BlockSpec(specs["in"][0][0], by_head),
+            pl.BlockSpec(specs["in"][1][0], by_full),
+            pl.BlockSpec(specs["in"][2][0], by_full),
+            pl.BlockSpec(specs["in"][3][0], by_x),
+            pl.BlockSpec(specs["in"][4][0], by_wo),
+        ],
+        out_specs=(pl.BlockSpec(specs["out"][0][0], by_x),
+                   pl.BlockSpec(specs["out"][1][0], by_head),
+                   pl.BlockSpec(specs["out"][2][0], by_lse)),
+        scratch_shapes=[pltpu.VMEM((bq, H), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
+        interpret=_INTERPRET,
+    )(qb, kb, vb, x, wo)
+
+
+def _fused_flash_bwd_heads(qb, kb, vb, gb, ob, lse, D, bq, bk):
+    """Flash backward over flattened-head [B, S, H] layout: the verified
+    resident kernel BODIES run unchanged — only the index maps differ,
+    slicing head h = bh % nh out of the last axis."""
+    from jax.experimental import pallas as pl
+    B, S, H = qb.shape
+    nh = H // D
+    scale = 1.0 / math.sqrt(D)
+    lse_bh = lse.reshape(B * nh, S, _LANES)  # contiguous: free reshape
+    specs = fused_attn_block_specs(B, S, H, D, bq, bk)
+
+    blocked = lambda bh, i: (bh // nh, i, bh % nh)   # noqa: E731
+    whole = lambda bh, i: (bh // nh, 0, bh % nh)     # noqa: E731
+    lse_blk = lambda bh, i: (bh, i, 0)               # noqa: E731
+    lse_full = lambda bh, i: (bh, 0, 0)              # noqa: E731
+
+    dq_specs = specs["bwd_dq"]
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel_resident, bq=bq, bk=bk,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B, S, H), qb.dtype),
+        grid=(B * nh, S // bq),
+        in_specs=[
+            pl.BlockSpec(dq_specs["in"][0][0], blocked),   # q
+            pl.BlockSpec(dq_specs["in"][1][0], whole),     # k
+            pl.BlockSpec(dq_specs["in"][2][0], whole),     # v
+            pl.BlockSpec(dq_specs["in"][3][0], blocked),   # g
+            pl.BlockSpec(dq_specs["in"][4][0], blocked),   # o
+            pl.BlockSpec(dq_specs["in"][5][0], lse_blk),   # lse
+        ],
+        out_specs=pl.BlockSpec(dq_specs["out"][0][0], blocked),
+        interpret=_INTERPRET,
+    )(qb, kb, vb, gb, ob, lse_bh)
+
+    dkv_specs = specs["bwd_dkv"]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel_resident, bq=bq, bk=bk,
+                          scale=scale, n_qblocks=S // bq),
+        out_shape=(jax.ShapeDtypeStruct((B, S, H), kb.dtype),
+                   jax.ShapeDtypeStruct((B, S, H), vb.dtype)),
+        grid=(B * nh, S // bk),
+        in_specs=[
+            pl.BlockSpec(dkv_specs["in"][0][0], whole),    # q
+            pl.BlockSpec(dkv_specs["in"][1][0], blocked),  # k
+            pl.BlockSpec(dkv_specs["in"][2][0], blocked),  # v
+            pl.BlockSpec(dkv_specs["in"][3][0], whole),    # g
+            pl.BlockSpec(dkv_specs["in"][4][0], whole),    # o
+            pl.BlockSpec(dkv_specs["in"][5][0], lse_full),  # lse
+        ],
+        out_specs=(pl.BlockSpec(dkv_specs["out"][0][0], blocked),
+                   pl.BlockSpec(dkv_specs["out"][1][0], blocked)),
+        interpret=_INTERPRET,
+    )(qb, kb, vb, gb, ob, lse_bh)
+    return dq, dk, dv
+
+
+def _fused_attention_fwd_impl(cfgt, x, ln, wq, wk, wv, wo, sin, cos):
+    head_dim, eps, bq, bk = cfgt
+    ln2d = ln.reshape(1, -1)
+    qb, kb, vb = _fused_qkv_proj(x, ln2d, wq, wk, wv, sin, cos,
+                                 head_dim, bq, eps)
+    y, attn, lse = _fused_attn_epilogue(qb, kb, vb, x, wo, head_dim, bq, bk)
+    return y, (x, ln, wq, wk, wv, wo, sin, cos, qb, kb, vb, attn, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_attention_call(cfgt, x, ln, wq, wk, wv, wo, sin, cos):
+    y, _ = _fused_attention_fwd_impl(cfgt, x, ln, wq, wk, wv, wo, sin, cos)
+    return y
+
+
+def _fused_attention_fwd(cfgt, x, ln, wq, wk, wv, wo, sin, cos):
+    return _fused_attention_fwd_impl(cfgt, x, ln, wq, wk, wv, wo, sin, cos)
+
+
+def _fused_attention_bwd(cfgt, res, dy):
+    head_dim, eps, bq, bk = cfgt
+    x, ln, wq, wk, wv, wo, sin, cos, qb, kb, vb, attn, lse = res
+    # epilogue transpose (jnp: plain MXU matmuls)
+    dwo = jnp.einsum("bsi,bsj->ij", attn, dy)
+    gb = jnp.einsum("bsj,ij->bsi", dy, wo)
+    # the O(S^2) core: the flash backward Pallas kernels
+    dqb, dkb, dvb = _fused_flash_bwd_heads(qb, kb, vb, gb, attn, lse,
+                                           head_dim, bq, bk)
+
+    # prologue transpose via jax.vjp of the jnp prologue: rmsnorm/rope/
+    # projection weight grads are pure matmul+elementwise work XLA runs
+    # at peak; hand-fusing them buys nothing over the flash core win
+    def prologue(x_, ln_, wq_, wk_, wv_, sin_, cos_):
+        xn = _rms_norm_ref(x_, ln_, eps)
+        return (_rope_flat(xn @ wq_, sin_, cos_, head_dim),
+                _rope_flat(xn @ wk_, sin_, cos_, head_dim),
+                xn @ wv_)
+
+    _, pvjp = jax.vjp(prologue, x, ln, wq, wk, wv, sin, cos)
+    dx_p, dln, dwq, dwk, dwv, dsin, dcos = pvjp((dqb, dkb, dvb))
+    return dy + dx_p, dln, dwq, dwk, dwv, dwo, dsin, dcos
+
+
+_fused_attention_call.defvjp(_fused_attention_fwd, _fused_attention_bwd)
+
+
+def fused_attention_block(x, ln, wq, wk, wv, wo, sin, cos, *, head_dim,
+                          eps=1e-6):
+    """Fused decoder-layer attention sub-block:
+    ``x + attn(rope(rms(x) @ wq), rope(rms(x) @ wk), rms(x) @ wv) @ wo``.
+
+    x: [B, S, H]; wq/wk/wv/wo: [H, H]; ln: [H]; sin/cos: [S, head_dim].
+    Pallas kernels (qkv-prologue + flash-with-epilogue) on TPU / under
+    the interpreter for qualifying shapes; the jnp reference composition
+    otherwise. Differentiable either way (custom_vjp reusing the flash
+    backward kernels on the fused path)."""
+    if fused_attention_available(x.shape, head_dim, x.dtype):
+        bq, bk = _fused_attn_config(x.shape[1], x.shape[2], head_dim,
+                                    x.dtype)
+        return _fused_attention_call((head_dim, float(eps), bq, bk),
+                                     x, ln, wq, wk, wv, wo, sin, cos)
+    return _attention_block_jnp(x, ln, wq, wk, wv, wo, sin, cos,
+                                head_dim, eps)
+
+
+# ---------------------------------------------------------------------------
+# fused MLP block
+# ---------------------------------------------------------------------------
+
+def _mlp_fused_kernel(x_ref, ln_ref, wg_ref, wu_ref, wd_ref, y_ref,
+                      xn_s, acc_s, *, eps):
+    from jax.experimental import pallas as pl
+    ii = pl.program_id(2)
+    n_i = pl.num_programs(2)
+
+    @pl.when(ii == 0)
+    def _init():
+        x32 = x_ref[0].astype(jnp.float32)
+        ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        xn = (x32 * lax.rsqrt(ms + eps)).astype(x_ref.dtype) * ln_ref[...]
+        xn_s[...] = xn.astype(jnp.float32)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    xn = xn_s[...].astype(x_ref.dtype)
+    g = lax.dot(xn, wg_ref[...], preferred_element_type=jnp.float32)
+    u = lax.dot(xn, wu_ref[...], preferred_element_type=jnp.float32)
+    a = (jax.nn.silu(g) * u).astype(x_ref.dtype)
+    acc_s[...] = acc_s[...] + lax.dot(a, wd_ref[...],
+                                      preferred_element_type=jnp.float32)
+
+    @pl.when(ii == n_i - 1)
+    def _flush():
+        y_ref[0] = (x_ref[0].astype(jnp.float32)
+                    + acc_s[...]).astype(y_ref.dtype)
+
+
+def _mlp_bwd_dx_kernel(x_ref, ln_ref, wg_ref, wu_ref, wd_ref, dy_ref,
+                       dx_ref, xn_s, dacc_s, *, eps):
+    from jax.experimental import pallas as pl
+    ii = pl.program_id(2)
+    n_i = pl.num_programs(2)
+
+    @pl.when(ii == 0)
+    def _init():
+        x32 = x_ref[0].astype(jnp.float32)
+        ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        xn = (x32 * lax.rsqrt(ms + eps)).astype(x_ref.dtype) * ln_ref[...]
+        xn_s[...] = xn.astype(jnp.float32)
+        dacc_s[...] = jnp.zeros_like(dacc_s)
+
+    xn = xn_s[...].astype(x_ref.dtype)
+    g = lax.dot(xn, wg_ref[...], preferred_element_type=jnp.float32)
+    u = lax.dot(xn, wu_ref[...], preferred_element_type=jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    # da = dy @ wd_blk^T   [bs, bi]
+    da = lax.dot_general(dy, wd_ref[...].astype(jnp.float32),
+                         (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    sg = jax.nn.sigmoid(g)
+    silu_g = g * sg
+    dsilu = sg + g * sg * (1.0 - sg)
+    dg = da * u * dsilu
+    du = da * silu_g
+    # dxn += dg @ wg_blk^T + du @ wu_blk^T
+    dacc_s[...] = dacc_s[...] + lax.dot_general(
+        dg, wg_ref[...].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) + lax.dot_general(
+        du, wu_ref[...].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ii == n_i - 1)
+    def _flush():
+        # RMSNorm backward + residual, fused into the last grid step:
+        # y = x + f(w * n(x)) with n(x) = x * rsqrt(mean(x^2) + eps)
+        # => dx_i = dy_i + r * dz_i - x_i * <dz, x> * r^3 / H
+        x32 = x_ref[0].astype(jnp.float32)
+        Hdim = x32.shape[-1]
+        ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        r = lax.rsqrt(ms + eps)
+        dz = dacc_s[...] * ln_ref[...].astype(jnp.float32)
+        inner = jnp.sum(dz * x32, axis=-1, keepdims=True)
+        dxn_x = dz * r - x32 * (inner * r * r * r / Hdim)
+        dx_ref[0] = (dy_ref[0].astype(jnp.float32)
+                     + dxn_x).astype(dx_ref.dtype)
+
+
+def _fused_mlp_pallas(kernel, inputs, out_dtype, S, H, I, bs, bi,
+                      which):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    B = inputs[0].shape[0]
+    specs = fused_mlp_block_specs(B, S, H, I, bs, bi)[which]
+    by_x = lambda b, i, ii: (b, i, 0)    # noqa: E731
+    by_ln = lambda b, i, ii: (0, 0)      # noqa: E731
+    by_gu = lambda b, i, ii: (0, ii)     # noqa: E731
+    by_d = lambda b, i, ii: (ii, 0)      # noqa: E731
+    maps = [by_x, by_ln, by_gu, by_gu, by_d] + \
+        ([by_x] if which == "bwd_dx" else [])
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, S, H), out_dtype),
+        grid=(B, S // bs, I // bi),
+        in_specs=[pl.BlockSpec(s[0], m)
+                  for s, m in zip(specs["in"], maps)],
+        out_specs=pl.BlockSpec(specs["out"][0][0], by_x),
+        scratch_shapes=[pltpu.VMEM((bs, H), jnp.float32),
+                        pltpu.VMEM((bs, H), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
+        interpret=_INTERPRET,
+    )(*inputs)
+
+
+def _fused_mlp_fwd_impl(cfgt, x, ln, wg, wu, wd):
+    eps, bs, bi = cfgt
+    B, S, H = x.shape
+    I = wg.shape[1]
+    y = _fused_mlp_pallas(
+        functools.partial(_mlp_fused_kernel, eps=eps),
+        (x, ln.reshape(1, -1), wg, wu, wd), x.dtype, S, H, I, bs, bi,
+        "fwd")
+    return y, (x, ln, wg, wu, wd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_mlp_call(cfgt, x, ln, wg, wu, wd):
+    y, _ = _fused_mlp_fwd_impl(cfgt, x, ln, wg, wu, wd)
+    return y
+
+
+def _fused_mlp_bwd(cfgt, res, dy):
+    eps, bs, bi = cfgt
+    x, ln, wg, wu, wd = res
+    B, S, H = x.shape
+    I = wg.shape[1]
+    # dx: fused Pallas kernel (recompute gate/up per intermediate block,
+    # accumulate dxn, RMSNorm backward + residual in the epilogue)
+    dx = _fused_mlp_pallas(
+        functools.partial(_mlp_bwd_dx_kernel, eps=eps),
+        (x, ln.reshape(1, -1), wg, wu, wd, dy), x.dtype, S, H, I, bs, bi,
+        "bwd_dx")
+
+    # weight + ln grads via jax.vjp of the jnp composition with x fixed:
+    # these are the big einsums XLA already runs at MXU peak
+    def wfn(ln_, wg_, wu_, wd_):
+        xn = _rms_norm_ref(x, ln_, eps)
+        return (jax.nn.silu(xn @ wg_) * (xn @ wu_)) @ wd_
+
+    _, wvjp = jax.vjp(wfn, ln, wg, wu, wd)
+    dln, dwg, dwu, dwd = wvjp(dy)
+    return dx, dln, dwg, dwu, dwd
+
+
+_fused_mlp_call.defvjp(_fused_mlp_fwd_impl, _fused_mlp_bwd)
+
+
+def fused_mlp_block(x, ln, w_gate, w_up, w_down, *, eps=1e-6):
+    """Fused decoder-layer MLP sub-block:
+    ``x + (silu(rms(x) @ w_gate) * (rms(x) @ w_up)) @ w_down``.
+
+    One persistent Pallas kernel forward (RMSNorm + gate/up + SiLU + down
+    + residual), fused dx kernel backward; recompute-based (saves only
+    the primal inputs — remat-friendly). jnp reference composition when
+    the shape/policy disqualifies the kernel."""
+    if fused_mlp_available(x.shape, w_gate.shape[1], x.dtype):
+        bs, bi = _fused_mlp_config(x.shape[1], x.shape[2],
+                                   w_gate.shape[1], x.dtype)
+        return _fused_mlp_call((float(eps), bs, bi),
+                               x, ln, w_gate, w_up, w_down)
+    return _mlp_block_jnp(x, ln, w_gate, w_up, w_down, eps)
+
+
+# ---------------------------------------------------------------------------
+# fused-op tuning + parity registry
+# ---------------------------------------------------------------------------
+
+def tune_fused_blocks(B, S, H, D, I, dtype=jnp.bfloat16, budget_s=None,
+                      iters=10, verbose=False):
+    """Measure the legal (bq, bk) / (bs, bi) candidates for the fused
+    attention and MLP blocks at this decoder shape and cache the winners
+    (ops "fused_attention" / "fused_mlp"). Times fwd+bwd together via a
+    chained scan, like tune_causal_attention. Returns
+    {"fused_attention": cfg|None, "fused_mlp": cfg|None}."""
+    from paddle_tpu.ops import autotune
+
+    dtype = jnp.dtype(dtype)
+    results = {}
+    if not (_on_tpu() or _INTERPRET):
+        return {"fused_attention": None, "fused_mlp": None}
+    ks = jax.random.split(jax.random.PRNGKey(0), 8)
+    x = (jax.random.normal(ks[0], (B, S, H), dtype) * 0.5)
+    dy = (jax.random.normal(ks[1], (B, S, H), dtype) * 0.5)
+    ln = jnp.ones((H,), dtype)
+    wq, wk, wv, wo = (jax.random.normal(kk, (H, H), dtype) * 0.02
+                      for kk in ks[2:6])
+    half = D // 2
+    ang = jnp.concatenate([jnp.arange(half, dtype=jnp.float32)] * 2)
+    pos = jnp.arange(S, dtype=jnp.float32)[:, None] * (ang + 1.0)[None, :]
+    sin, cos = jnp.sin(pos), jnp.cos(pos)
+    n_chain = max(1, int(iters))
+
+    def timed(fn, *args):
+        import numpy as np
+        import time as _time
+
+        @jax.jit
+        def chained(*a):
+            def body(c, _):
+                return c + fn(c, *a[1:]) * jnp.asarray(1e-6, c.dtype), None
+            out, _ = lax.scan(body, a[0], None, length=n_chain)
+            return jnp.sum(out[0, 0])
+
+        float(np.asarray(chained(*args)))  # compile + warmup
+        reps = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            float(np.asarray(chained(*args)))
+            reps.append(_time.perf_counter() - t0)
+        return min(reps) / n_chain
+
+    def time_attn(cand):
+        bq, bk = cand
+
+        def step(xc, *rest):
+            f = lambda t: _fused_attention_call(  # noqa: E731
+                (D, 1e-6, bq, bk), t, ln, wq, wk, wv, wo, sin, cos)
+            y, pull = jax.vjp(f, xc)
+            (dx,) = pull(dy)
+            return y + dx
+
+        return timed(step, x)
+
+    akey = ["blocks", int(S), int(H), int(D)] + autotune.context_key(
+        str(dtype))
+    results["fused_attention"] = autotune.tune(
+        "fused_attention", akey, fused_attn_candidates(B, S, H, D, dtype),
+        time_attn, budget_s=budget_s, verbose=verbose)
+
+    wg = jax.random.normal(ks[6], (H, I), dtype) * 0.02
+    wu = jax.random.normal(ks[7], (H, I), dtype) * 0.02
+    wd = jnp.swapaxes(wu, 0, 1) * 1.0
+
+    def time_mlp(cand):
+        bs, bi = cand
+
+        def step(xc):
+            f = lambda t: _fused_mlp_call(  # noqa: E731
+                (1e-6, bs, bi), t, ln, wg, wu, wd)
+            y, pull = jax.vjp(f, xc)
+            (dx,) = pull(dy)
+            return y + dx
+
+        return timed(step, x)
+
+    mkey = ["blocks", int(S), int(H), int(I)] + autotune.context_key(
+        str(dtype))
+    results["fused_mlp"] = autotune.tune(
+        "fused_mlp", mkey, fused_mlp_candidates(B, S, H, I, dtype),
+        time_mlp, budget_s=budget_s, verbose=verbose)
+    return results
+
+
+def fused_parity_cases():
+    """(name, fused_fn, reference_fn, make_args) for the fused decoder-
+    block kernels — the parity registry ops/codegen.py re-exports and
+    tests/test_pallas_fused.py sweeps (fwd and bwd, interpret mode)."""
+    D = 128
+
+    def attn_args(key, B=1, S=256, H=256, dtype=jnp.float32):
+        ks = jax.random.split(key, 7)
+        x = jax.random.normal(ks[0], (B, S, H), dtype) * 0.5
+        ln = 1.0 + 0.1 * jax.random.normal(ks[1], (H,), dtype)
+        wq, wk, wv, wo = (jax.random.normal(kk, (H, H), dtype) * 0.05
+                          for kk in ks[2:6])
+        half = D // 2
+        inv = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32)
+                                 / half))
+        ang = jnp.arange(S, dtype=jnp.float32)[:, None] * inv[None, :]
+        emb = jnp.concatenate([ang, ang], axis=-1)
+        return (x, ln, wq, wk, wv, wo, jnp.sin(emb), jnp.cos(emb))
+
+    def mlp_args(key, B=1, S=256, H=256, I=512, dtype=jnp.float32):
+        ks = jax.random.split(key, 5)
+        x = jax.random.normal(ks[0], (B, S, H), dtype) * 0.5
+        ln = 1.0 + 0.1 * jax.random.normal(ks[1], (H,), dtype)
+        wg = jax.random.normal(ks[2], (H, I), dtype) * 0.05
+        wu = jax.random.normal(ks[3], (H, I), dtype) * 0.05
+        wd = jax.random.normal(ks[4], (I, H), dtype) * 0.05
+        return (x, ln, wg, wu, wd)
+
+    return [
+        ("fused_attention_block",
+         functools.partial(fused_attention_block, head_dim=D, eps=1e-6),
+         functools.partial(_attention_block_jnp, head_dim=D, eps=1e-6),
+         attn_args),
+        ("fused_mlp_block",
+         functools.partial(fused_mlp_block, eps=1e-6),
+         functools.partial(_mlp_block_jnp, eps=1e-6),
+         mlp_args),
+    ]

@@ -320,6 +320,9 @@ class Profiler:
         lines.append("-" * len(header))
         lines.extend(numerics.summary_lines())
         lines.append("-" * len(header))
+        from ..ops import autotune as _autotune
+        lines.extend(_autotune.summary_lines())
+        lines.append("-" * len(header))
         from ..analysis import core as _lint_core
         lines.extend(_lint_core.summary_lines())
         lines.append("-" * len(header))

@@ -150,6 +150,12 @@ def aot_compile(source: str, name: str, jit_fn, args, kwargs=None,
     points call this INSTEAD of letting the first traced call compile
     internally, then dispatch every same-signature call through the
     returned executable."""
+    # every framework compile funnels through here — activating the
+    # persistent XLA cache at this chokepoint gives tests/examples/
+    # tools warm starts when FLAGS_tpu_persistent_cache is on
+    # (ensure() is internally best-effort: off-or-failed is a no-op)
+    from paddle_tpu.core import compile_cache
+    compile_cache.ensure()
     try:
         lowered = jit_fn.lower(*args, **(kwargs or {}))
         compiled = lowered.compile()
@@ -165,6 +171,8 @@ def analyze(jit_fn, *abstract_args, source: str = "manual",
     abstract jax.ShapeDtypeStruct) arguments: compiles, captures, and
     returns (profile, compiled). Raises on compile failure — the
     explicit-analysis path (pod_report) wants the real error."""
+    from paddle_tpu.core import compile_cache
+    compile_cache.ensure()
     lowered = jit_fn.lower(*abstract_args, **abstract_kwargs)
     compiled = lowered.compile()
     profile = capture_compiled(

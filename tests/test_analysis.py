@@ -328,6 +328,41 @@ def test_ast_syntax_error_is_a_finding():
     assert [f.rule for f in found] == ["syntax-error"]
 
 
+def test_ast_mosaic_block_shape_fires_on_illegal_literal():
+    # the exact BENCH_r02 failure: a (1, 256) LSE block — second-to-last
+    # dim 1 is neither divisible by 8 nor (statically knowably) equal to
+    # the array dim
+    found = _check("""\
+    from jax.experimental import pallas as pl
+    def make_specs(S):
+        a = pl.BlockSpec((1, 256), lambda i: (i, 0))
+        b = pl.BlockSpec(block_shape=(8, 100), index_map=lambda i: (i, 0))
+        c = pl.BlockSpec((64,), lambda i: (i,))
+        return a, b, c
+    """)
+    hits = {f.line: f for f in found if f.rule == "mosaic-block-shape"}
+    assert sorted(hits) == [3, 4, 5]
+    assert hits[3].severity == "warning"
+    assert "% 8" in hits[3].message           # (1, 256): sublane dim
+    assert "% 128" in hits[4].message         # (8, 100): lane dim
+    assert "% 128" in hits[5].message         # rank-1 64
+
+
+def test_ast_mosaic_block_shape_clean_cases():
+    # legal literals, variable shapes (autotuned -> not judgeable), other
+    # BlockSpec-named calls without a shape, and pragma suppression
+    found = _check("""\
+    from jax.experimental import pallas as pl
+    def make_specs(bq, S):
+        ok = pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))
+        var = pl.BlockSpec((1, bq, 256), lambda i: (i, 0, 0))
+        none = pl.BlockSpec(memory_space=None)
+        sup = pl.BlockSpec((1, 256), lambda i: (i, 0))  # tpu-lint: disable=mosaic-block-shape
+        return ok, var, none, sup
+    """)
+    assert "mosaic-block-shape" not in _rules_of(found)
+
+
 # ---------------------------------------------------------------------------
 # pragma suppression
 # ---------------------------------------------------------------------------

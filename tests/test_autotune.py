@@ -143,6 +143,125 @@ def test_flash_nondefault_blocks_numerics():
         pallas_ops._INTERPRET = old
 
 
+def test_save_after_partial_load_merges(tmp_path):
+    """save() after a partial load() must not clobber on-disk entries for
+    ops this process never re-tuned (the warmup-job workflow: one process
+    tunes op A, another op B, both write the same cache file)."""
+    path = str(tmp_path / "cache.json")
+    # a prior process tuned opA/k1 and opB/k2
+    autotune.record("opA", ["k1"], (1, 1))
+    autotune.record("opB", ["k2"], (2, 2))
+    autotune.save(path)
+    # fresh process: loads nothing, tunes only opA/k3
+    autotune._CACHE.clear()
+    autotune.record("opA", ["k3"], (3, 3))
+    autotune.save(path)
+    autotune._CACHE.clear()
+    autotune.load(path)
+    assert autotune.lookup("opA", ["k1"]) == (1, 1)   # survived
+    assert autotune.lookup("opB", ["k2"]) == (2, 2)   # survived
+    assert autotune.lookup("opA", ["k3"]) == (3, 3)   # added
+    # in-memory wins on a key conflict
+    autotune._CACHE.clear()
+    autotune.record("opA", ["k1"], (9, 9))
+    autotune.save(path)
+    autotune._CACHE.clear()
+    autotune.load(path)
+    assert autotune.lookup("opA", ["k1"]) == (9, 9)
+
+
+def test_save_merge_tolerates_corrupt_file(tmp_path):
+    path = tmp_path / "cache.json"
+    path.write_text("{not json")
+    autotune.record("op", ["k"], (1, 2))
+    autotune.save(str(path))  # must not raise
+    autotune._CACHE.clear()
+    autotune.load(str(path))
+    assert autotune.lookup("op", ["k"]) == (1, 2)
+
+
+def test_lookup_chain_counts_one_hit_or_miss():
+    autotune.record("op", ["specific"], (4, 4))
+    h0, m0 = autotune._HITS, autotune._MISSES
+    # fallback probe that misses then hits: exactly one hit total
+    assert autotune.lookup_chain("op", [["missing"], ["specific"]]) == (4, 4)
+    assert (autotune._HITS - h0, autotune._MISSES - m0) == (1, 0)
+    # all probes miss: exactly one miss total
+    assert autotune.lookup_chain("op", [["a"], ["b"], ["c"]]) is None
+    assert (autotune._HITS - h0, autotune._MISSES - m0) == (1, 1)
+
+
+def test_context_key_carries_dtype_device_jaxlib():
+    key = autotune.context_key("bfloat16")
+    assert len(key) == 3 and key[0] == "bfloat16"
+    import jaxlib
+    assert key[2] == jaxlib.__version__
+    # different dtypes produce different keys -> distinct cache entries
+    assert autotune.context_key("float32") != key
+
+
+def test_legal_candidates_filters_and_disqualifies():
+    calls = []
+
+    def spec_fn(cand):
+        calls.append(cand)
+        if cand == "skip":
+            return None
+        # cand IS the block shape here; array huge so no equality escape
+        return [(cand, (4096, 4096))]
+
+    pool = ["skip", (8, 128), (1, 256), (8, 256), (8, 128)]
+    got = autotune.legal_candidates(pool, spec_fn)
+    assert got == [(8, 128), (8, 256)]       # (1, 256) is the r02 shape
+    assert calls.count((8, 128)) == 1        # deduped before spec_fn
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("S", [256, 384, 512, 2048, 2304, 4096])
+def test_flash_candidates_always_legal_property(S, dtype):
+    """Property: across a shapes x dtypes grid, the candidate generator
+    yields ONLY configs whose every BlockSpec is Mosaic-legal and that
+    tile S — illegal shapes are unrepresentable, not merely filtered at
+    launch time."""
+    bits = 8 * jnp.dtype(dtype).itemsize
+    cands = pallas_ops.flash_candidates(S, 128, dtype)
+    assert cands, f"no legal candidate at S={S}"
+    for bq, bk in cands:
+        assert S % bq == 0 and S % bk == 0
+        specs = pallas_ops.flash_block_specs(8, S, 128, bq, bk)
+        for kernel, groups in specs.items():
+            for io in ("in", "out"):
+                for blk, arr in groups[io]:
+                    assert pallas_ops.mosaic_block_legal(
+                        blk, arr, dtype_bits=bits), (
+                        f"S={S} bq={bq} bk={bk} {kernel}/{io}: "
+                        f"{blk} vs {arr}")
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape", [(256, 256, 512), (512, 512, 1024),
+                                   (2048, 2048, 5632)])
+def test_fused_candidates_always_legal_property(shape, dtype):
+    S, H, I = shape
+    bits = 8 * jnp.dtype(dtype).itemsize
+    for cands, spec_builder, dims in (
+            (pallas_ops.fused_attn_candidates(1, S, H, 128, dtype),
+             lambda c: pallas_ops.fused_attn_block_specs(8, S, H, 128, *c),
+             "attn"),
+            (pallas_ops.fused_mlp_candidates(1, S, H, I, dtype),
+             lambda c: pallas_ops.fused_mlp_block_specs(8, S, H, I, *c),
+             "mlp")):
+        assert cands, f"no legal {dims} candidate at {shape}"
+        for cand in cands:
+            for kernel, groups in spec_builder(cand).items():
+                for io in ("in", "out"):
+                    for blk, arr in groups[io]:
+                        assert pallas_ops.mosaic_block_legal(
+                            blk, arr, dtype_bits=bits), (
+                            f"{dims} {shape} {cand} {kernel}/{io}: "
+                            f"{blk} vs {arr}")
+
+
 def test_committed_bench_cache_short_circuits_tuning():
     """bench.py seeds tuning from .flash_autotune.json; a cache hit must
     return the winner without measuring (no device work)."""

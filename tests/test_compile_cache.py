@@ -1,0 +1,71 @@
+"""FLAGS_tpu_persistent_cache / core.compile_cache: the framework-wide
+persistent XLA compilation cache promoted out of bench.py."""
+import os
+
+import jax
+import pytest
+
+from paddle_tpu.core import compile_cache, flags
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    saved_flag = flags.flag("FLAGS_tpu_persistent_cache")
+    saved_dir = jax.config.jax_compilation_cache_dir
+    saved_env = os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR")
+    compile_cache._reset_for_tests()
+    yield
+    compile_cache._reset_for_tests()
+    flags.set_flags({"FLAGS_tpu_persistent_cache": saved_flag})
+    jax.config.update("jax_compilation_cache_dir", saved_dir)
+    if saved_env is None:
+        os.environ.pop("PADDLE_TPU_COMPILE_CACHE_DIR", None)
+    else:
+        os.environ["PADDLE_TPU_COMPILE_CACHE_DIR"] = saved_env
+
+
+def test_flag_off_is_noop():
+    flags.set_flags({"FLAGS_tpu_persistent_cache": False})
+    assert compile_cache.ensure() is None
+    assert not compile_cache.enabled()
+
+
+def test_flag_on_activates_and_is_idempotent(tmp_path):
+    os.environ["PADDLE_TPU_COMPILE_CACHE_DIR"] = str(tmp_path / "cc")
+    flags.set_flags({"FLAGS_tpu_persistent_cache": True})
+    path = compile_cache.ensure()
+    assert path == str(tmp_path / "cc") and os.path.isdir(path)
+    assert compile_cache.enabled()
+    assert jax.config.jax_compilation_cache_dir == path
+    assert compile_cache.ensure() == path  # repeat call: cached answer
+
+
+def test_force_overrides_flag(tmp_path):
+    os.environ["PADDLE_TPU_COMPILE_CACHE_DIR"] = str(tmp_path / "cc")
+    flags.set_flags({"FLAGS_tpu_persistent_cache": False})
+    assert compile_cache.ensure() is None          # flag says no
+    assert compile_cache.ensure(force=True) is not None  # bench says yes
+    assert compile_cache.enabled()
+
+
+def test_default_dir_is_bench_compatible():
+    # the framework default must be the .jax_cache dir bench.py has
+    # always written, so existing warm caches keep hitting
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.environ.pop("PADDLE_TPU_COMPILE_CACHE_DIR", None)
+    assert compile_cache.cache_dir() == os.path.join(repo, ".jax_cache")
+
+
+def test_aot_compile_path_respects_flag(tmp_path):
+    """xmem.aot_compile (the jit/api.py AOT chokepoint) activates the
+    cache when the flag is on."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.profiler import xmem
+
+    os.environ["PADDLE_TPU_COMPILE_CACHE_DIR"] = str(tmp_path / "cc")
+    flags.set_flags({"FLAGS_tpu_persistent_cache": True})
+    fn = jax.jit(lambda x: x * 2)
+    compiled = xmem.aot_compile("test", "double", fn, (jnp.ones((4,)),))
+    assert compiled is not None
+    assert compile_cache.enabled()

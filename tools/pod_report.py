@@ -221,6 +221,10 @@ def build_report(args):
         for k, sh in step_fn.batch_shardings.items()}
 
     xmem.enable()
+    # abstract compiles of 7B-scale steps take minutes; the persistent
+    # XLA cache (FLAGS_tpu_persistent_cache) makes repeat reports warm
+    from paddle_tpu.core import compile_cache
+    compile_cache.ensure()
     t0 = time.perf_counter()
     with topo.mesh:
         profile, compiled = xmem.analyze(
