@@ -289,59 +289,18 @@ def main():
 def _init_device_with_retries(probe_fn, window_s=240.0, base_delay=5.0,
                               factor=2.0, max_delay=60.0, log=None,
                               sleep=time.sleep, clock=time.monotonic):
-    """Retry transient device-backend init failures with exponential
-    backoff until the `window_s` budget expires.
-
-    A dead axon tunnel fails two ways: `probe_fn` raises (claim refused
-    — often transient while another job releases the chip, so retry),
-    or it never returns (make_c_api_client hang). Each attempt runs on
-    its own daemon thread so a hang is bounded by the remaining window
-    instead of blocking forever; a hung attempt is NOT retried, because
-    the runtime's init lock would block every later attempt behind it.
-
-    Returns (ok, attempts, last_error). Injectable sleep/clock keep the
-    backoff schedule unit-testable without real waiting."""
-    import threading
-
-    deadline = clock() + window_s
-    delay = base_delay
-    attempts = 0
-    last_err = "no attempt made"
-    while clock() < deadline:
-        attempts += 1
-        box = {}
-        done = threading.Event()
-
-        def _attempt():
-            try:
-                probe_fn()
-                box["ok"] = True
-            except Exception as e:  # noqa: BLE001 — classified below
-                box["err"] = str(e) or repr(e)
-            finally:
-                done.set()
-
-        th = threading.Thread(target=_attempt, daemon=True)
-        th.start()
-        finished = done.wait(max(0.0, deadline - clock()))
-        if box.get("ok"):
-            return True, attempts, None
-        if not finished:
-            return False, attempts, (
-                f"attempt {attempts} hung past the {window_s:.0f}s window")
-        last_err = box.get("err", "unknown init failure")
-        pause = min(delay, max(0.0, deadline - clock()))
-        if pause <= 0:
-            break
-        if log:
-            log(f"device init attempt {attempts} failed ({last_err}); "
-                f"retrying in {pause:.1f}s")
-        sleep(pause)
-        delay = min(delay * factor, max_delay)
-    return False, attempts, last_err
+    """Delegates to the shared runtime watchdog
+    (paddle_tpu.runtime.watchdog.init_with_retries, where bench's
+    original retry loop now lives); kept under the bench-local name for
+    existing callers. Returns (ok, attempts, last_error)."""
+    from paddle_tpu.runtime.watchdog import init_with_retries
+    return init_with_retries(
+        probe_fn, window_s=window_s, base_delay=base_delay,
+        factor=factor, max_delay=max_delay, log=log, sleep=sleep,
+        clock=clock, phase="device_init")
 
 
-def _error_result(msg):
+def _error_result(msg, incident=None):
     out = {
         "metric": "llama_train_mfu_1chip",
         "value": 0.0,
@@ -349,6 +308,17 @@ def _error_result(msg):
         "vs_baseline": 0.0,
         "error": msg[-1500:] or "unknown",
     }
+    # structured incident record from the runtime health layer: what
+    # phase hung/failed and against which deadline — a 0.0 with a cause,
+    # never a silent stale carry-forward
+    if incident is None:
+        try:
+            from paddle_tpu.runtime.watchdog import last_incident
+            incident = last_incident()
+        except Exception:
+            incident = None
+    if incident is not None:
+        out["incident"] = incident
     # last successful real-chip measurement, if one is recorded (written
     # by a successful run and committed alongside the code it measured —
     # never a hardcoded constant that outlives the code it described)
@@ -362,34 +332,34 @@ def _error_result(msg):
 
 def run():
     """Never exit without the JSON line: a failed bench prints value 0.0
-    with the error attached, and a staged watchdog covers hangs by
-    printing the error record before the driver's own timeout kills the
-    process silently. Stage 1: device init gets a retry window
-    (PADDLE_TPU_BENCH_DEVICE_TIMEOUT total, exponential backoff from
-    PADDLE_TPU_BENCH_DEVICE_RETRY_DELAY) — transient claim failures
-    retry, a hung make_c_api_client fails fast instead of burning the
-    whole budget (round 3's 0.0). Stage 2: the full measurement must
-    land within PADDLE_TPU_BENCH_TIMEOUT."""
-    import threading
+    with the error attached, and the shared runtime watchdog
+    (paddle_tpu.runtime.watchdog) covers hangs by printing the error
+    record — with the structured incident attached — before the
+    driver's own timeout kills the process silently. Stage 1: device
+    init gets a retry window (PADDLE_TPU_BENCH_DEVICE_TIMEOUT total,
+    exponential backoff from PADDLE_TPU_BENCH_DEVICE_RETRY_DELAY) —
+    transient claim failures retry, a hung make_c_api_client fails fast
+    instead of burning the whole budget (round 3's 0.0). Stage 2: the
+    full measurement must land within PADDLE_TPU_BENCH_TIMEOUT."""
+    from paddle_tpu.runtime.watchdog import (PhaseTimeout,
+                                             run_with_deadline)
+    from paddle_tpu.testing.chaos import chaos_point
 
     timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1000"))
     dev_timeout_s = float(
         os.environ.get("PADDLE_TPU_BENCH_DEVICE_TIMEOUT", "240"))
     retry_delay_s = float(
         os.environ.get("PADDLE_TPU_BENCH_DEVICE_RETRY_DELAY", "5"))
-    box = {}
 
-    def _measure():
-        try:
-            box["result"] = main()
-        except BaseException as e:  # noqa: BLE001 — the line must print
-            box["result"] = _error_result(str(e) or repr(e))
+    def _probe():
+        chaos_point("device.init")
+        jax.devices()
 
-    # probe device init (with retries) before the measure thread starts,
-    # so measurement never runs against a dead tunnel
+    # probe device init (with retries) before measurement starts, so it
+    # never runs against a dead tunnel
     ok, attempts, err = _init_device_with_retries(
-        lambda: jax.devices(), window_s=dev_timeout_s,
-        base_delay=retry_delay_s, log=_log)
+        _probe, window_s=dev_timeout_s, base_delay=retry_delay_s,
+        log=_log)
     if not ok:
         print(json.dumps(_error_result(
             f"device backend init failed within {dev_timeout_s:.0f}s "
@@ -398,16 +368,17 @@ def run():
         sys.stdout.flush()
         os._exit(0)  # a hung init thread would block a clean exit
 
-    t = threading.Thread(target=_measure, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
+    try:
+        result = run_with_deadline(main, timeout_s, phase="measure")
+    except PhaseTimeout:
         print(json.dumps(_error_result(
             f"bench timed out after {timeout_s:.0f}s "
             "(compile or execute hang)")))
         sys.stdout.flush()
-        os._exit(0)
-    print(json.dumps(box["result"]))
+        os._exit(0)  # the hung measure thread would block a clean exit
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        result = _error_result(str(e) or repr(e))
+    print(json.dumps(result))
     return 0
 
 

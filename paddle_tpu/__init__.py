@@ -56,6 +56,7 @@ from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import profiler  # noqa: E402
+from . import runtime  # noqa: E402
 from . import analysis  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402

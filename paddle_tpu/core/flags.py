@@ -90,6 +90,31 @@ define_flag("FLAGS_tpu_persistent_cache", False,
             "PADDLE_TPU_COMPILE_CACHE_DIR). Warm starts skip XLA "
             "compilation entirely; safe to leave on — entries are keyed "
             "by HLO + jaxlib + topology.")
+define_flag("FLAGS_tpu_watchdog", False,
+            "Runtime health layer (paddle_tpu.runtime): phase watchdogs "
+            "with faulthandler dumps on expiry, cross-rank heartbeat "
+            "failure detection, and collective entry/exit beacons that "
+            "convert a hung peer into an exit-101 elastic relaunch "
+            "within the configured deadline. Off: every hook is a "
+            "module-global None check.")
+define_flag("FLAGS_tpu_watchdog_device_init", 240.0,
+            "Deadline (s) for the device_init watchdog phase — the "
+            "budget for claiming a backend before the attempt is "
+            "declared hung. <=0 disables.")
+define_flag("FLAGS_tpu_watchdog_compile", 600.0,
+            "Deadline (s) for the compile watchdog phase (trace + XLA "
+            "compile of one executable). <=0 disables.")
+define_flag("FLAGS_tpu_watchdog_first_step", 300.0,
+            "Deadline (s) for the first_step watchdog phase (first "
+            "post-compile step, which still pays transfer/warmup "
+            "costs). <=0 disables.")
+define_flag("FLAGS_tpu_watchdog_collective", 120.0,
+            "Deadline (s) a rank may spend inside one collective before "
+            "the health monitor declares a CollectiveTimeout and "
+            "converts it to an exit-101 relaunch. <=0 disables.")
+define_flag("FLAGS_tpu_watchdog_ckpt_commit", 300.0,
+            "Deadline (s) for the ckpt.commit watchdog phase (the "
+            "atomic checkpoint rename + fsync protocol). <=0 disables.")
 define_flag("FLAGS_tpu_xmem", False,
             "Capture per-executable memory_analysis()/cost_analysis() "
             "(HBM peaks, temp bytes, flops) at every jit/Executor/"

@@ -401,17 +401,26 @@ def rebind_inplace(x: "Tensor", out: "Tensor") -> "Tensor":
 
 
 def _maybe_check_nan_inf(op_name, tensors):
-    """FLAGS_check_nan_inf analog (paddle/fluid/eager/nan_inf_utils.cc)."""
+    """FLAGS_check_nan_inf analog (paddle/fluid/eager/nan_inf_utils.cc).
+
+    One device-side reduction per float output, fused into a single
+    host readback — ``bool(...)`` per tensor would round-trip
+    host<->device once per output inside the loop."""
     if not flag("FLAGS_check_nan_inf"):
         return
+    checks = []
     for t in tensors:
         arr = t._array
         if isinstance(arr, jax.core.Tracer):
             continue
         if jnp.issubdtype(arr.dtype, jnp.floating):
-            if bool(jnp.any(~jnp.isfinite(arr))):
-                raise FloatingPointError(
-                    f"NaN/Inf detected in output of op '{op_name}'")
+            checks.append(jnp.any(~jnp.isfinite(arr)))
+    if not checks:
+        return
+    bad = jax.device_get(jnp.any(jnp.stack(checks)))
+    if bool(bad):
+        raise FloatingPointError(
+            f"NaN/Inf detected in output of op '{op_name}'")
 
 
 # ---------------------------------------------------------------------------

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.tensor import Tensor, apply_op
+from ..testing.chaos import chaos_point
 from .mesh import get_mesh
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
@@ -143,15 +144,26 @@ def _in_trace(x):
 # ---------------------------------------------------------------------------
 
 def _apply_collective(f, tensor, op_name):
-    """apply_op with telemetry: a host span when a profiler is live and,
-    when FLAGS_tpu_metrics is on, bytes-moved counters + a latency
-    histogram per collective op. The un-instrumented path costs one list
-    truthiness check and one dict-lookup+bool (metrics.enabled)."""
+    """apply_op with telemetry and health instrumentation: a host span
+    when a profiler is live; when FLAGS_tpu_metrics is on, bytes-moved
+    counters + a latency histogram per collective op; when a runtime
+    HealthMonitor is installed, an entry/exit beacon (so a rank that
+    enters and never exits is detected within the collective deadline)
+    plus a ``collective.<op>`` chaos point for hang injection. The
+    un-instrumented path costs one list truthiness check, one
+    dict-lookup+bool (metrics.enabled), and two module-global None
+    checks (health hook, chaos hook)."""
     from ..profiler import _record_span, metrics as _metrics
+    from ..runtime import health as _health
     rec = _metrics.enabled()
     t0 = time.perf_counter() if rec else None
     with _record_span(f"collective/{op_name}"):
-        out = apply_op(f, tensor, op_name=op_name)
+        # beacon outermost: the chaos hang below must count as "inside
+        # the collective" so self-detection sees the overdue beacon
+        with _health.collective_beacon(op_name):
+            chaos_point(f"collective.{op_name}",
+                        step=_health.current_step())
+            out = apply_op(f, tensor, op_name=op_name)
     if rec:
         a = getattr(tensor, "_array", tensor)
         try:

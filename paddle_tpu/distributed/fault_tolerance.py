@@ -213,22 +213,27 @@ def commit_dir(tmp_dir: str, final_dir: str, *, overwrite: bool = True,
     Order: manifest into tmp (durable) -> move any existing final aside
     -> atomic rename tmp->final (THE commit point) -> drop the old copy.
     A kill between any two steps leaves a state :func:`recover_dir` maps
-    back to exactly one committed checkpoint.
+    back to exactly one committed checkpoint. Under FLAGS_tpu_watchdog
+    the whole protocol runs inside the ``ckpt.commit`` phase (a hung
+    fsync on a dying disk produces a stack dump + incident within
+    FLAGS_tpu_watchdog_ckpt_commit seconds).
     """
-    man = write_manifest(tmp_dir, extra=extra)
-    chaos_point("ft.commit.swap", step=(extra or {}).get("step"),
-                path=final_dir)
-    old = final_dir + OLD_SUFFIX
-    if os.path.exists(final_dir):
-        if not overwrite:
-            raise FileExistsError(final_dir)
+    from ..runtime import watchdog as _watchdog
+    with _watchdog.phase("ckpt.commit"):
+        man = write_manifest(tmp_dir, extra=extra)
+        chaos_point("ft.commit.swap", step=(extra or {}).get("step"),
+                    path=final_dir)
+        old = final_dir + OLD_SUFFIX
+        if os.path.exists(final_dir):
+            if not overwrite:
+                raise FileExistsError(final_dir)
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(final_dir, old)
+        os.replace(tmp_dir, final_dir)
+        _fsync_dir(os.path.dirname(final_dir) or ".")
         if os.path.exists(old):
-            shutil.rmtree(old)
-        os.rename(final_dir, old)
-    os.replace(tmp_dir, final_dir)
-    _fsync_dir(os.path.dirname(final_dir) or ".")
-    if os.path.exists(old):
-        shutil.rmtree(old, ignore_errors=True)
+            shutil.rmtree(old, ignore_errors=True)
     return man
 
 

@@ -74,11 +74,28 @@ class _NativeStore:
     def get(self, key: str) -> Optional[bytes]:
         return self._get(self._lib.ptq_store_get, key)
 
-    def wait(self, key: str) -> bytes:
-        out = self._get(self._lib.ptq_store_wait, key)
-        if out is None:
-            raise TimeoutError(f"TCPStore.wait({key!r}) aborted")
-        return out
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        if timeout is None:
+            # server-side blocking wait: returns when the key lands
+            out = self._get(self._lib.ptq_store_wait, key)
+            if out is None:
+                raise TimeoutError(f"TCPStore.wait({key!r}) aborted")
+            return out
+        # bounded wait: poll `get` against a local deadline instead of
+        # abandoning a blocking wait mid-reply (which would desync the
+        # connection's request/response framing)
+        deadline = time.monotonic() + timeout
+        poll_s = 0.02
+        while True:
+            out = self._get(self._lib.ptq_store_get, key)
+            if out is not None:
+                return out
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"TCPStore.wait({key!r}) timed out after {timeout:.1f}s")
+            time.sleep(min(poll_s, remaining))
+            poll_s = min(poll_s * 2, 0.25)
 
     def add(self, key: str, delta: int = 1) -> int:
         v = self._lib.ptq_store_add(self._h, key.encode(), delta)
@@ -107,6 +124,7 @@ class _PyStore:
 
     def __init__(self, host, port, is_master, timeout):
         self.port = port
+        self.timeout = timeout  # store-level default honored by wait()
 
     def set(self, key, value):
         with self._CV:
@@ -117,11 +135,14 @@ class _PyStore:
         with self._LOCK:
             return self._GLOBAL.get(key)
 
-    def wait(self, key, timeout=300.0):
+    def wait(self, key, timeout=None):
+        if timeout is None:
+            timeout = self.timeout
         with self._CV:
             ok = self._CV.wait_for(lambda: key in self._GLOBAL, timeout)
             if not ok:
-                raise TimeoutError(f"wait({key!r}) timed out")
+                raise TimeoutError(
+                    f"TCPStore.wait({key!r}) timed out after {timeout:.1f}s")
             return self._GLOBAL[key]
 
     def add(self, key, delta=1):
@@ -156,6 +177,7 @@ class TCPStore:
                  retry_max_delay: float = 2.0):
         self.host = host
         self.world_size = world_size
+        self.timeout = float(timeout)  # default budget for wait()
         if native.available():
             self._impl = _NativeStore(host, port, is_master, timeout)
         else:
@@ -204,11 +226,16 @@ class TCPStore:
             return self._impl.get(key)
         return self._with_retries("get", _op)
 
-    def wait(self, key: str) -> bytes:
-        return self._impl.wait(key)
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Block until ``key`` exists, up to ``timeout`` (default: the
+        store-level ``TCPStore(timeout=...)`` value). Raises
+        ``TimeoutError`` with identical semantics on both backends."""
+        chaos_point("store.wait", path=None, key=key)
+        return self._impl.wait(
+            key, self.timeout if timeout is None else timeout)
 
-    def get_obj(self, key: str):
-        raw = self._impl.wait(key)
+    def get_obj(self, key: str, timeout: Optional[float] = None):
+        raw = self.wait(key, timeout)
         return pickle.loads(raw)
 
     def add(self, key: str, delta: int = 1) -> int:

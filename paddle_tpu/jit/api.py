@@ -210,11 +210,18 @@ class StaticFunction:
                            str(getattr(a, "dtype", "?")))
                           for a in dyn_arrays)
         sig = (key, shape_sig)
-        if new_closure or sig not in self._trace_sigs:
+        new_sig = new_closure or sig not in self._trace_sigs
+        if new_sig:
             if len(self._trace_sigs) < 4096:
                 self._trace_sigs.add(sig)
             from ..profiler import compile_tracker
             compile_tracker.record_trace(self._trace_name)
+            # hang injection + phase watchdog for the trace+compile that
+            # this new signature is about to pay (chaos no-op unless a
+            # schedule is installed; phase no-op unless
+            # FLAGS_tpu_watchdog)
+            from ..testing.chaos import chaos_point
+            chaos_point("jit.compile")
             # trace-time static analysis (to_static(lint=True) or
             # FLAGS_tpu_lint): lint the jaxpr of every NEW signature —
             # host callbacks in loops, f64 promotion, oversized consts,
@@ -230,28 +237,31 @@ class StaticFunction:
         # unhashable static leaf (key None) never caches, so it keeps
         # the plain traced path
         compiled = self._aot_cache.get(sig) if key is not None else None
-        if compiled is None and key is not None:
-            from ..profiler import xmem
-            if xmem.enabled():
-                compiled = xmem.aot_compile(
-                    "to_static", self._trace_name, jitted, dyn_arrays,
-                    sig=shape_sig)
-                if compiled is not None:
-                    self._aot_cache[sig] = compiled
-                    if len(self._aot_cache) > self._jit_cache_cap:
-                        self._aot_cache.popitem(last=False)
-        if compiled is not None:
-            self._aot_cache.move_to_end(sig)
-            try:
-                out = compiled(*dyn_arrays)
-            except Exception:
-                # AOT executables pin device placement/sharding, which
-                # the shape signature doesn't key on — drop the entry
-                # and let pjit handle the call
-                self._aot_cache.pop(sig, None)
+        from contextlib import nullcontext
+        from ..runtime import watchdog as _watchdog
+        with (_watchdog.phase("compile") if new_sig else nullcontext()):
+            if compiled is None and key is not None:
+                from ..profiler import xmem
+                if xmem.enabled():
+                    compiled = xmem.aot_compile(
+                        "to_static", self._trace_name, jitted, dyn_arrays,
+                        sig=shape_sig)
+                    if compiled is not None:
+                        self._aot_cache[sig] = compiled
+                        if len(self._aot_cache) > self._jit_cache_cap:
+                            self._aot_cache.popitem(last=False)
+            if compiled is not None:
+                self._aot_cache.move_to_end(sig)
+                try:
+                    out = compiled(*dyn_arrays)
+                except Exception:
+                    # AOT executables pin device placement/sharding,
+                    # which the shape signature doesn't key on — drop
+                    # the entry and let pjit handle the call
+                    self._aot_cache.pop(sig, None)
+                    out = jitted(*dyn_arrays)
+            else:
                 out = jitted(*dyn_arrays)
-        else:
-            out = jitted(*dyn_arrays)
         # numerics watchdog (FLAGS_tpu_check_nan_inf): every to_static
         # function is a watched function. Disabled path: dict lookup.
         from ..profiler import numerics as _numerics
@@ -277,8 +287,14 @@ class StaticFunction:
         try:
             report = _numerics.localize(self._converted_fn,
                                         *args, **kwargs)
-        except Exception:  # localization must never mask the finding
-            pass
+        except (TypeError, ValueError, RuntimeError, KeyError,
+                AttributeError) as e:
+            # localization re-interprets the jaxpr and can fail on
+            # shapes/tracers the original call handled — the finding
+            # itself must still be dispatched, just without a culprit
+            import logging
+            logging.getLogger(__name__).debug(
+                "numerics localization failed at %s: %s", site, e)
         _numerics._dispatch(site, summary, _default_action(),
                             report=report)
 

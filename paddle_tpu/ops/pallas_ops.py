@@ -90,6 +90,36 @@ _INTERPRET = False
 # never cost the run its number).
 _DISABLE = False
 
+# Runtime degradation (per-kernel, in-process): a fused block that fails
+# while tracing/executing falls back to its jnp reference path — the
+# parity oracle, so numerics are preserved — and the kernel stays off
+# for the rest of the process instead of failing every step.
+_RUNTIME_FALLBACK = set()
+
+
+def _fused_guard(kernel, fused_fn, ref_fn):
+    """Dispatch to the fused kernel with graceful degradation: on the
+    first failure record a ``fused_fallback_total{kernel=}`` incident
+    and answer with the reference path; later calls skip the broken
+    kernel entirely. Execution-time errors inside an outer jit surface
+    at the jit boundary, not here — that layer is bench.py's _DISABLE
+    retry ladder; this guard covers eager/interpret execution and
+    trace/lower failures."""
+    if kernel in _RUNTIME_FALLBACK:
+        return ref_fn()
+    try:
+        return fused_fn()
+    except Exception as e:  # noqa: BLE001 — any kernel failure degrades
+        _RUNTIME_FALLBACK.add(kernel)
+        from paddle_tpu.runtime import health as _health
+        _health.record_fused_fallback(kernel, e)
+        import sys as _sys
+        _sys.stderr.write(
+            f"pallas_ops: fused kernel {kernel!r} failed "
+            f"({str(e)[-300:]}); falling back to the jnp reference "
+            "path for the rest of the process\n")
+        return ref_fn()
+
 
 def _on_tpu():
     try:
@@ -1359,13 +1389,18 @@ def fused_attention_block(x, ln, wq, wk, wv, wo, sin, cos, *, head_dim,
     the interpreter for qualifying shapes; the jnp reference composition
     otherwise. Differentiable either way (custom_vjp reusing the flash
     backward kernels on the fused path)."""
+    def _ref():
+        return _attention_block_jnp(x, ln, wq, wk, wv, wo, sin, cos,
+                                    head_dim, eps)
+
     if fused_attention_available(x.shape, head_dim, x.dtype):
-        bq, bk = _fused_attn_config(x.shape[1], x.shape[2], head_dim,
-                                    x.dtype)
-        return _fused_attention_call((head_dim, float(eps), bq, bk),
-                                     x, ln, wq, wk, wv, wo, sin, cos)
-    return _attention_block_jnp(x, ln, wq, wk, wv, wo, sin, cos,
-                                head_dim, eps)
+        def _fused():
+            bq, bk = _fused_attn_config(x.shape[1], x.shape[2], head_dim,
+                                        x.dtype)
+            return _fused_attention_call((head_dim, float(eps), bq, bk),
+                                         x, ln, wq, wk, wv, wo, sin, cos)
+        return _fused_guard("fused_attention", _fused, _ref)
+    return _ref()
 
 
 # ---------------------------------------------------------------------------
@@ -1527,12 +1562,17 @@ def fused_mlp_block(x, ln, w_gate, w_up, w_down, *, eps=1e-6):
     + residual), fused dx kernel backward; recompute-based (saves only
     the primal inputs — remat-friendly). jnp reference composition when
     the shape/policy disqualifies the kernel."""
+    def _ref():
+        return _mlp_block_jnp(x, ln, w_gate, w_up, w_down, eps)
+
     if fused_mlp_available(x.shape, w_gate.shape[1], x.dtype):
-        bs, bi = _fused_mlp_config(x.shape[1], x.shape[2],
-                                   w_gate.shape[1], x.dtype)
-        return _fused_mlp_call((float(eps), bs, bi),
-                               x, ln, w_gate, w_up, w_down)
-    return _mlp_block_jnp(x, ln, w_gate, w_up, w_down, eps)
+        def _fused():
+            bs, bi = _fused_mlp_config(x.shape[1], x.shape[2],
+                                       w_gate.shape[1], x.dtype)
+            return _fused_mlp_call((float(eps), bs, bi),
+                                   x, ln, w_gate, w_up, w_down)
+        return _fused_guard("fused_mlp", _fused, _ref)
+    return _ref()
 
 
 # ---------------------------------------------------------------------------

@@ -329,6 +329,9 @@ class Profiler:
         from ..distributed import fault_tolerance as _ft
         lines.extend(_ft.summary_lines())
         lines.append("-" * len(header))
+        from .. import runtime as _runtime
+        lines.extend(_runtime.summary_lines())
+        lines.append("-" * len(header))
         if self._step_times:
             lines.append(self.step_info(time_unit))
         return "\n".join(lines)

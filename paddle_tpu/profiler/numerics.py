@@ -222,8 +222,12 @@ def _eqn_frame(eqn) -> Tuple[Optional[str], Optional[int]]:
         fr = source_info_util.user_frame(eqn.source_info)
         if fr is not None:
             return fr.file_name, int(fr.start_line)
-    except Exception:
-        pass
+    except (ImportError, AttributeError, TypeError, ValueError) as e:
+        # jax._src layout moves between versions; attribution is
+        # best-effort garnish on the finding, never a reason to fail it
+        import logging
+        logging.getLogger(__name__).debug(
+            "eqn frame attribution failed: %s", e)
     return None, None
 
 
@@ -410,8 +414,14 @@ def watch(fn: Callable, name: Optional[str] = None,
             report = None
             try:
                 report = localize(fn, *args, **kwargs)
-            except Exception:  # localization must never mask the finding
-                pass
+            except (TypeError, ValueError, RuntimeError, KeyError,
+                    AttributeError) as e:
+                # localization re-interprets the jaxpr and can fail on
+                # inputs the original call handled — the finding must
+                # still be dispatched, just without a culprit
+                import logging
+                logging.getLogger(__name__).debug(
+                    "numerics localization failed at %s: %s", site, e)
             _dispatch(site, summary, action, report=report)
         return out
 

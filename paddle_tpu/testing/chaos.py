@@ -14,9 +14,19 @@ and every matching point fires an injected fault:
     crash       os._exit(code)        — kill -9 mid-save semantics
     raise       raise ChaosError      — in-process crash simulation
     sigterm     SIGTERM to self       — preemption notice
-    hang        sleep(sleep_s)        — stuck worker / heartbeat stall
+    hang        sleep forever (or ``secs=`` seconds) — stuck worker; the
+                watchdog/health layer must detect and convert it
+    stall       sleep ``secs=`` (default 1.0) then continue — a slow
+                rank / transient straggler, recovers on its own
     disconnect  raise ConnectionResetError — transient store failure
     truncate    truncate the file at the point's ``path``
+
+Gang-aware options: ``rank=`` fires only on that trainer
+(``PADDLE_TRAINER_ID``) and ``restart=`` only in that elastic
+generation (``PADDLE_RESTART_COUNT``) — so ``hang@collective.
+all_reduce:step=3,restart=0`` hangs the first generation and lets the
+relaunched one run clean, matched at fire time because the env is
+inherited by every rank and every generation.
 
 Schedules are deterministic: rules match on point name (fnmatch
 pattern), optional ``step``, fire at most ``times`` times after skipping
@@ -47,7 +57,12 @@ __all__ = ["Chaos", "ChaosError", "Rule", "chaos_point", "install",
            "uninstall", "active", "installed", "install_from_env",
            "truncate_file", "corrupt_file"]
 
-ACTIONS = ("crash", "raise", "sigterm", "hang", "disconnect", "truncate")
+ACTIONS = ("crash", "raise", "sigterm", "hang", "stall", "disconnect",
+           "truncate")
+
+# injectable so infinite-hang tests can count chunks instead of sleeping
+_SLEEP = time.sleep
+_HANG_CHUNK_S = 60.0
 
 
 class ChaosError(RuntimeError):
@@ -60,7 +75,10 @@ class Rule:
     def __init__(self, action: str, point: str, *, step: Optional[int] = None,
                  times: Optional[int] = None, after: int = 0,
                  prob: Optional[float] = None, exit_code: int = 42,
-                 frac: float = 0.5, sleep_s: float = 3600.0):
+                 frac: float = 0.5, secs: Optional[float] = None,
+                 sleep_s: Optional[float] = None,
+                 rank: Optional[int] = None,
+                 restart: Optional[int] = None):
         if action not in ACTIONS:
             raise ValueError(f"unknown chaos action {action!r}; "
                              f"one of {ACTIONS}")
@@ -72,12 +90,20 @@ class Rule:
         self.prob = prob
         self.exit_code = int(exit_code)
         self.frac = float(frac)
-        self.sleep_s = float(sleep_s)
+        # `secs` bounds hang/stall; `sleep_s` kept as a spelling alias.
+        # hang without secs sleeps FOREVER (the realistic stuck-worker
+        # shape — detection is the watchdog's job, not the injector's);
+        # stall without secs pauses 1s and recovers.
+        if secs is None and sleep_s is not None:
+            secs = sleep_s
+        self.secs = None if secs is None else float(secs)
+        self.rank = None if rank is None else int(rank)
+        self.restart = None if restart is None else int(restart)
         self.hits = 0    # matching visits (post step-filter)
         self.fired = 0   # times the fault actually fired
 
-    _INT_KEYS = {"step", "times", "after", "exit_code"}
-    _FLOAT_KEYS = {"prob", "frac", "sleep_s"}
+    _INT_KEYS = {"step", "times", "after", "exit_code", "rank", "restart"}
+    _FLOAT_KEYS = {"prob", "frac", "sleep_s", "secs"}
 
     @classmethod
     def parse(cls, spec: str) -> "Rule":
@@ -128,10 +154,19 @@ class Chaos:
 
     def hit(self, point: str, step: Optional[int] = None,
             path: Optional[str] = None, **_kw):
+        # gang gating read at fire time (once per hit, not per rule):
+        # PTQ_CHAOS is inherited by every rank and every elastic
+        # generation, so rules carry their own rank/restart filters
+        env_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        env_restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
         for r in self.rules:
             if not fnmatch.fnmatchcase(point, r.point):
                 continue
             if r.step is not None and step != r.step:
+                continue
+            if r.rank is not None and env_rank != r.rank:
+                continue
+            if r.restart is not None and env_restart != r.restart:
                 continue
             r.hits += 1
             if r.hits <= r.after:
@@ -156,7 +191,13 @@ class Chaos:
             os.kill(os.getpid(), signal.SIGTERM)
             return
         if r.action == "hang":
-            time.sleep(r.sleep_s)
+            if r.secs is not None:
+                _SLEEP(r.secs)
+                return
+            while True:  # the real thing: stuck until something kills us
+                _SLEEP(_HANG_CHUNK_S)
+        if r.action == "stall":
+            _SLEEP(1.0 if r.secs is None else r.secs)
             return
         if r.action == "disconnect":
             raise ConnectionResetError(

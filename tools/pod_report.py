@@ -298,7 +298,27 @@ def build_report(args):
             "flops_reported": profile["flops"],
             "bytes_accessed": profile["bytes_accessed"],
         },
+        "notes": _plan_notes(n_dev),
     }
+
+
+def _plan_notes(n_dev):
+    """Advisory lines attached to the report. A multi-host plan (more
+    chips than one host carries — 8 on every supported generation)
+    depends on DCN rendezvous and gang collectives, where a single hung
+    rank stalls the whole job; flag it when the runtime health layer
+    (FLAGS_tpu_watchdog) is off."""
+    notes = []
+    from paddle_tpu.core.flags import flag
+    if n_dev > 8 and not flag("FLAGS_tpu_watchdog"):
+        notes.append(
+            f"multi-host plan ({n_dev} chips) with FLAGS_tpu_watchdog "
+            "disabled: a hung rank in device init or a collective will "
+            "stall the gang with no bounded-time recovery — set "
+            "FLAGS_tpu_watchdog=1 (deadlines: FLAGS_tpu_watchdog_* ; "
+            "see docs/robustness.md) to convert hangs into exit-101 "
+            "elastic relaunches")
+    return notes
 
 
 def main(argv=None):
