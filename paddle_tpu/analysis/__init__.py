@@ -1,4 +1,4 @@
-"""Static-analysis suite (`tpu_lint`): jaxpr + AST hazard checks.
+"""Static-analysis suite (`tpu_lint`): jaxpr + AST + kernel/SPMD checks.
 
 Level 1 (``jaxpr_checks``) lints any traceable function *without
 executing it* — hidden host callbacks in loop bodies, silent f64
@@ -12,19 +12,42 @@ Level 2 (``ast_checks``) lints Python source — the ``tools/tpu_lint.py``
 CLI runs it over the framework itself (self-hosting, with a checked-in
 baseline at ``tools/tpu_lint_baseline.json``).
 
+Level 3 (``kernel_checks`` + ``spmd_checks``) goes below the jaxpr:
+the kernel verifier intercepts every ``pl.pallas_call`` during tracing
+(or replays registered kernels via ``verify_kernel`` /
+``verify_registered``) and proves grid/BlockSpec divisibility, in-bounds
+index maps, output coverage, Mosaic tiling legality, and VMEM budgets —
+all on CPU, nothing executes. The SPMD checker abstractly executes a
+jaxpr per rank-group to prove all ranks issue the same collective
+sequence (deadlock-by-divergence at trace time), plus axis-name misuse
+and donation-vs-sharding conflicts. Both feed ``check_jaxpr`` /
+``lint_callable``; the CLI's ``--kernels`` mode runs the registry.
+
 See docs/static_analysis.md for the rule catalogue and pragma syntax.
 """
 from . import core
 from . import ast_checks
 from . import jaxpr_checks
+from . import spmd_checks
+from . import kernel_checks
 from .core import (ERROR, WARNING, Finding, enabled, findings, record,
                    reset, summary_lines)
 from .ast_checks import AST_RULES, check_file, check_paths, check_source
 from .jaxpr_checks import (DEFAULT_CONFIG, JAXPR_RULES, check_jaxpr,
                            lint_callable, lint_traced)
+from .spmd_checks import SPMD_RULES, check_spmd, collective_events
+from .kernel_checks import (DEFAULT_KERNEL_CONFIG, KERNEL_RULES,
+                            capture_sites, check_sites,
+                            register_kernel_case, register_kernel_provider,
+                            verify_kernel, verify_module, verify_registered)
 
-__all__ = ["core", "ast_checks", "jaxpr_checks", "Finding", "ERROR",
-           "WARNING", "enabled", "findings", "record", "reset",
-           "summary_lines", "AST_RULES", "JAXPR_RULES", "DEFAULT_CONFIG",
-           "check_file", "check_paths", "check_source", "check_jaxpr",
-           "lint_callable", "lint_traced"]
+__all__ = ["core", "ast_checks", "jaxpr_checks", "spmd_checks",
+           "kernel_checks", "Finding", "ERROR", "WARNING", "enabled",
+           "findings", "record", "reset", "summary_lines", "AST_RULES",
+           "JAXPR_RULES", "SPMD_RULES", "KERNEL_RULES", "DEFAULT_CONFIG",
+           "DEFAULT_KERNEL_CONFIG", "check_file", "check_paths",
+           "check_source", "check_jaxpr", "check_spmd", "check_sites",
+           "collective_events", "capture_sites", "lint_callable",
+           "lint_traced", "register_kernel_case",
+           "register_kernel_provider", "verify_kernel", "verify_module",
+           "verify_registered"]

@@ -314,10 +314,13 @@ def _check_collective_divergence(closed, cfg, name) -> List[Finding]:
 
 def check_jaxpr(closed, name: Optional[str] = None,
                 donate_argnums=(), config: Optional[dict] = None,
-                rules=None) -> List[Finding]:
-    """Run every (or the selected) jaxpr rule over a ClosedJaxpr.
-    Findings carry file:line from each eqn's source_info; pragmas in the
-    attributed source files are honored."""
+                rules=None, axis_names=None) -> List[Finding]:
+    """Run every (or the selected) jaxpr rule over a ClosedJaxpr —
+    the Level-1 rules above plus the Level-3 SPMD consistency rules
+    (``spmd_checks``). Findings carry file:line from each eqn's
+    source_info; pragmas in the attributed source files are honored.
+    ``axis_names``, when given, is the set of mesh axes the deployment
+    defines (enables the spmd-axis-misuse undefined-axis check)."""
     cfg = dict(DEFAULT_CONFIG)
     if config:
         cfg.update(config)
@@ -329,12 +332,16 @@ def check_jaxpr(closed, name: Optional[str] = None,
             out.extend(fn(closed, cfg, name, donate_argnums=donate_argnums))
         else:
             out.extend(fn(closed, cfg, name))
+    from . import spmd_checks
+    out.extend(spmd_checks.check_spmd(closed, name=name,
+                                      axis_names=axis_names,
+                                      config=config, rules=rules))
     return filter_file_pragmas(out)
 
 
 def lint_callable(fn: Callable, *args, name: Optional[str] = None,
                   donate_argnums=(), config: Optional[dict] = None,
-                  rules=None, **kwargs) -> List[Finding]:
+                  rules=None, axis_names=None, **kwargs) -> List[Finding]:
     """Trace ``fn(*args, **kwargs)`` to a jaxpr (never executing it) and
     lint it. Accepts jax.ShapeDtypeStructs in place of real arrays."""
     import jax
@@ -342,19 +349,28 @@ def lint_callable(fn: Callable, *args, name: Optional[str] = None,
     closed = jax.make_jaxpr(traced)(*args)
     return check_jaxpr(closed, name=name or getattr(
         fn, "__qualname__", getattr(fn, "__name__", repr(fn))),
-        donate_argnums=donate_argnums, config=config, rules=rules)
+        donate_argnums=donate_argnums, config=config, rules=rules,
+        axis_names=axis_names)
 
 
-def lint_traced(jitted: Callable, dyn_arrays, name: Optional[str] = None
-                ) -> List[Finding]:
+def lint_traced(jitted: Callable, dyn_arrays, name: Optional[str] = None,
+                donate_argnums=()) -> List[Finding]:
     """Trace-time hook used by ``to_static``: lint a fresh jit signature
-    and record the findings. Must never break the traced call — any
-    analysis failure is swallowed."""
+    and record the findings. Tracing runs under the Level-3 kernel
+    verifier's ``capture_sites`` shim, so every ``pl.pallas_call`` the
+    program reaches is verified too. Must never break the traced call —
+    any analysis failure is swallowed."""
     from . import core as _core
     try:
         import jax
-        closed = jax.make_jaxpr(jitted)(*dyn_arrays)
-        found = check_jaxpr(closed, name=name)
+        from . import kernel_checks
+        sites: list = []
+        with kernel_checks.capture_sites(sites):
+            closed = jax.make_jaxpr(jitted)(*dyn_arrays)
+        found = check_jaxpr(closed, name=name,
+                            donate_argnums=donate_argnums)
+        if sites:
+            found = found + kernel_checks.check_sites(sites, name=name)
     except Exception:
         return []
     _core.record(found)

@@ -10,9 +10,12 @@ fallbacks keep every feature usable).
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
+
+_log = logging.getLogger(__name__)
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
 _LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libpaddle_tpu_rt.so"))
@@ -136,5 +139,7 @@ class ShmQueue:
         try:
             if self._owner:
                 self.free()
-        except Exception:
-            pass
+        except (OSError, AttributeError) as e:
+            # interpreter teardown: the ctypes lib or our fields may
+            # already be gone — nothing to free, but say so at debug
+            _log.debug("ShmQueue.__del__: free failed: %s", e)

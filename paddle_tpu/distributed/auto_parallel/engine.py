@@ -219,9 +219,14 @@ class Engine:
         if not self._metrics:
             return
         outs_t = outs if isinstance(outs, (tuple, list)) else [outs]
-        for m in self._metrics:
-            corr = m.compute(outs_t[0], *labels)
-            m.update(corr.numpy() if isinstance(corr, Tensor) else corr)
+        # compute every metric's stats device-side first, then fetch them
+        # in ONE jax.device_get — a per-metric .numpy() was a blocking
+        # device->host round-trip on every train step
+        corrs = [m.compute(outs_t[0], *labels) for m in self._metrics]
+        host = jax.device_get([c._array if isinstance(c, Tensor) else c
+                               for c in corrs])
+        for m, h in zip(self._metrics, host):
+            m.update(h)
 
     # -- loops -------------------------------------------------------------
     def _as_loader(self, data, batch_size, shuffle, num_workers=0,

@@ -15,6 +15,7 @@ kwargs), results come back pickled; `rpc_async` returns a
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import socket
@@ -31,6 +32,8 @@ __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "refresh_worker_infos", "get_worker_info",
            "get_all_worker_infos", "get_current_worker_info",
            "WorkerInfo"]
+
+_log = logging.getLogger(__name__)
 
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
@@ -202,8 +205,10 @@ def shutdown():
     _state["running"] = False
     try:
         _state["listener"].close()
-    except Exception:
-        pass
+    except (OSError, AttributeError) as e:
+        # a listener that died mid-serve (or was never created) has
+        # nothing left to close; keep tearing the rest down
+        _log.debug("rpc shutdown: listener close failed: %s", e)
     if store is not None:
         try:
             store.close()

@@ -304,10 +304,13 @@ class DataLoader:
                     shm = None
 
         # apply child-env overrides around start(): both fork and spawn
-        # children inherit os.environ as of start() time
+        # children inherit os.environ as of start() time. Snapshot the
+        # environment ONCE before the loop (a per-key environ.get is an
+        # env lookup per iteration).
+        env_before = dict(_os.environ)
         saved_env = {}
         for k, v in self._worker_child_env().items():
-            saved_env[k] = _os.environ.get(k)
+            saved_env[k] = env_before.get(k)
             if v is None:
                 _os.environ.pop(k, None)
             else:

@@ -153,26 +153,28 @@ class StaticFunction:
         new_closure = jitted is None
         if jitted is not None:
             self._jit_cache.move_to_end(key)
+
+        # donate_argnums name TOP-LEVEL positional args; remap them
+        # to the positions of those args' dynamic leaves in the
+        # compacted call signature (also fed to the lint hook below,
+        # which can fire on a cached closure seeing a new shape sig)
+        donate = ()
+        if self._donate_argnums:
+            spans = []
+            pos = 0
+            for a in args:
+                n = len(jax.tree_util.tree_flatten(
+                    a, is_leaf=is_tensor_leaf)[0])
+                spans.append(range(pos, pos + n))
+                pos += n
+            donated_flat = {i for j in self._donate_argnums
+                            if j < len(spans) for i in spans[j]}
+            donate = tuple(k for k, i in enumerate(dyn_idx)
+                           if i in donated_flat)
+
         if jitted is None:
             fn = self._converted_fn
             n_leaves = len(flat)
-
-            # donate_argnums name TOP-LEVEL positional args; remap them
-            # to the positions of those args' dynamic leaves in the
-            # compacted call signature
-            donate = ()
-            if self._donate_argnums:
-                spans = []
-                pos = 0
-                for a in args:
-                    n = len(jax.tree_util.tree_flatten(
-                        a, is_leaf=is_tensor_leaf)[0])
-                    spans.append(range(pos, pos + n))
-                    pos += n
-                donated_flat = {i for j in self._donate_argnums
-                                if j < len(spans) for i in spans[j]}
-                donate = tuple(k for k, i in enumerate(dyn_idx)
-                               if i in donated_flat)
 
             def call_with_static(*dyn_arrays):
                 # only sizes/static values are captured — never the
@@ -225,13 +227,16 @@ class StaticFunction:
             # trace-time static analysis (to_static(lint=True) or
             # FLAGS_tpu_lint): lint the jaxpr of every NEW signature —
             # host callbacks in loops, f64 promotion, oversized consts,
-            # donation/collective hazards — without executing anything.
-            # lint_traced never raises into the traced call.
+            # donation/collective/SPMD hazards — and verify every
+            # pl.pallas_call the trace reaches (Level-3 kernel checks),
+            # without executing anything. lint_traced never raises into
+            # the traced call.
             from ..analysis import core as _lint_core
             if self._lint or _lint_core.enabled():
                 from ..analysis import jaxpr_checks as _jaxpr_checks
                 _jaxpr_checks.lint_traced(jitted, dyn_arrays,
-                                          name=self._trace_name)
+                                          name=self._trace_name,
+                                          donate_argnums=donate)
         # xmem capture: compile new signatures ahead-of-time so the ONE
         # compile also yields memory_analysis/cost_analysis; an
         # unhashable static leaf (key None) never caches, so it keeps

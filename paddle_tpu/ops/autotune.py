@@ -29,7 +29,17 @@ import numpy as np
 
 __all__ = ["set_config", "enabled", "lookup", "lookup_chain", "record",
            "tune", "save", "load", "time_callable", "cache_stats",
-           "context_key", "legal_candidates", "entries", "summary_lines"]
+           "context_key", "legal_candidates", "entries", "summary_lines",
+           "mosaic_block_legal"]
+
+
+def mosaic_block_legal(block_shape, array_shape, dtype_bits=32):
+    """Re-export of ``pallas_ops.mosaic_block_legal`` — the single
+    Mosaic tiling predicate shared by candidate filtering here and the
+    Level-3 kernel verifier (analysis/kernel_checks). Lazy so importing
+    autotune never pays the pallas_ops import."""
+    from paddle_tpu.ops.pallas_ops import mosaic_block_legal as _legal
+    return _legal(block_shape, array_shape, dtype_bits=dtype_bits)
 
 # op_name -> {key(str): config(list|tuple)}
 _CACHE: dict = {}
@@ -260,14 +270,20 @@ def time_callable(fn, args, warmup=1, iters=5):
 
 
 def tune(op_name: str, key, candidates, time_candidate, budget_s=None,
-         verbose=False):
+         verbose=False, verify_candidate=None):
     """Pick the fastest config from ``candidates`` by measurement.
 
     ``time_candidate(config) -> seconds`` (raise to disqualify — e.g. the
     config fails to compile or OOMs VMEM). The winner is recorded in the
     cache and returned; a prior cached winner short-circuits. ``budget_s``
     bounds total tuning time: remaining candidates are skipped once spent
-    (the best seen so far still wins)."""
+    (the best seen so far still wins).
+
+    ``verify_candidate(config) -> list of problems`` (empty/None = ok)
+    runs the Level-3 kernel verifier BEFORE any compile: a refuted
+    candidate is rejected at trace time instead of burning tuning budget
+    on a Mosaic compile error (or worse, a kernel that compiles but
+    reads out of bounds)."""
     cached = lookup(op_name, key)
     if cached is not None:
         return cached
@@ -278,6 +294,19 @@ def tune(op_name: str, key, candidates, time_candidate, budget_s=None,
     for cand in candidates:
         if budget_s is not None and time.perf_counter() - t_start > budget_s:
             break
+        if verify_candidate is not None:
+            try:
+                problems = verify_candidate(cand)
+            except Exception as e:  # verifier itself failed: don't block
+                problems = None
+                if verbose:
+                    sys.stderr.write(f"autotune[{op_name}] {cand}: "
+                                     f"verifier error ({e})\n")
+            if problems:
+                if verbose:
+                    sys.stderr.write(f"autotune[{op_name}] {cand}: refuted "
+                                     f"by kernel verifier ({problems[0]})\n")
+                continue
         try:
             t = time_candidate(cand)
         except Exception as e:  # disqualified: compile error / OOM

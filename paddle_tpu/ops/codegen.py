@@ -31,7 +31,7 @@ import jax
 from .registry import OP_LIBRARY
 
 __all__ = ["export_manifest", "fast_op", "parity_cases",
-           "fused_parity_cases"]
+           "fused_parity_cases", "kernel_verify_cases"]
 
 
 def _signature(fn: Callable) -> str:
@@ -184,4 +184,12 @@ def fused_parity_cases():
     than a numpy ufunc. tests/test_pallas_fused.py sweeps these fwd+bwd
     under the Pallas interpreter."""
     from paddle_tpu.ops.pallas_ops import fused_parity_cases as _cases
+    return _cases()
+
+
+def kernel_verify_cases():
+    """(name, traceable fn, example avals) for every Pallas kernel this
+    op library generates code against — the hook tools/tpu_lint.py
+    ``--kernels`` looks for, same shape as the parity sweeps above."""
+    from paddle_tpu.ops.pallas_ops import kernel_verify_cases as _cases
     return _cases()

@@ -41,7 +41,8 @@ from . import metrics as _metrics
 
 __all__ = ["enabled", "enable", "disable", "capture_compiled", "analyze",
            "aot_compile", "profiles", "stats", "reset", "max_static_peak",
-           "total_generated_code", "summary_lines", "peak_bytes_of"]
+           "total_generated_code", "summary_lines", "peak_bytes_of",
+           "record_kernel_estimate", "kernel_estimates"]
 
 _FLAG_DICT = _flags._REGISTRY
 _FLAG_NAME = "FLAGS_tpu_xmem"
@@ -212,6 +213,43 @@ def total_generated_code() -> int:
         return sum(p["generated_code_bytes"] for p in _STORE.values())
 
 
+# ---------------------------------------------------------------------------
+# Pallas kernel VMEM estimates (fed by analysis/kernel_checks — the
+# Level-3 verifier computes blocks+scratch per pallas_call site; this
+# store makes the numbers visible to the Profiler and pod_report)
+# ---------------------------------------------------------------------------
+
+_KERNELS: "OrderedDict[tuple, Dict[str, Any]]" = OrderedDict()
+_KERNELS_CAP = 256
+
+
+def record_kernel_estimate(kernel: str, vmem_bytes: int, **detail) -> None:
+    """Record one kernel's estimated per-invocation VMEM footprint.
+    Keyed by (kernel, call site) so retracing the same site updates in
+    place; LRU-bounded like the executable store."""
+    entry = {"kernel": kernel, "vmem_bytes": int(vmem_bytes)}
+    entry.update(detail)
+    key = (kernel, entry.get("where", ""))
+    with _lock:
+        _KERNELS[key] = entry
+        _KERNELS.move_to_end(key)
+        while len(_KERNELS) > _KERNELS_CAP:
+            _KERNELS.popitem(last=False)
+    if _metrics.enabled():
+        _metrics.gauge(
+            "xmem_kernel_vmem_bytes",
+            "Estimated per-invocation VMEM of a verified Pallas kernel",
+            kernel=kernel[:120]).set(entry["vmem_bytes"])
+
+
+def kernel_estimates() -> List[Dict[str, Any]]:
+    """Snapshot of recorded kernel VMEM estimates, largest first."""
+    with _lock:
+        vals = [dict(v) for v in _KERNELS.values()]
+    vals.sort(key=lambda e: -e["vmem_bytes"])
+    return vals
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if abs(n) < 1024.0 or unit == "TiB":
@@ -225,12 +263,14 @@ def summary_lines(top: int = 8) -> List[str]:
     captured executable, largest static peak first."""
     with _lock:
         vals = sorted(_STORE.values(), key=lambda p: -p["peak_bytes"])
+        kernels = sorted(_KERNELS.values(),
+                         key=lambda e: -e["vmem_bytes"])
     lines = ["Memory"]
     if not vals:
         hint = ("  (no executables captured — set FLAGS_tpu_xmem or "
                 "FLAGS_tpu_metrics before compiling)")
         lines.append(hint)
-        return lines
+        return lines + _kernel_lines(kernels, top)
     lines.append(f"  executables: {len(vals)}  "
                  f"(static peaks from compiled.memory_analysis)")
     header = (f"  {'Executable':<38}{'PeakHBM':>12}{'Temp':>12}"
@@ -246,6 +286,22 @@ def summary_lines(top: int = 8) -> List[str]:
     if len(vals) > top:
         lines.append(f"  ... {len(vals) - top} more "
                      f"(xmem.profiles() has all)")
+    return lines + _kernel_lines(kernels, top)
+
+
+def _kernel_lines(kernels: List[Dict[str, Any]], top: int) -> List[str]:
+    if not kernels:
+        return []
+    lines = [f"  Pallas kernels: {len(kernels)}  "
+             f"(VMEM estimates from the Level-3 verifier)"]
+    for e in kernels[:top]:
+        budget = e.get("budget_bytes")
+        verdict = ""
+        if budget:
+            verdict = (" OVER" if e["vmem_bytes"] > budget else " ok")
+            verdict += f" (budget {_fmt_bytes(budget)})"
+        lines.append(f"    {e['kernel'][:36]:<36}"
+                     f"{_fmt_bytes(e['vmem_bytes']):>12}{verdict}")
     return lines
 
 
@@ -253,3 +309,4 @@ def reset():
     """Drop all captured profiles (tests / between benchmark cases)."""
     with _lock:
         _STORE.clear()
+        _KERNELS.clear()

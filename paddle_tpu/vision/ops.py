@@ -559,7 +559,9 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
             dets = dets[:keep_top_k]
         counts.append(len(dets))
         for c, s, box, fi in dets:
-            outs.append([float(c), float(s), *box.tolist()])
+            # box is already a host numpy row here — unpack it directly
+            # (a .tolist() per detection reads as a per-iteration sync)
+            outs.append([float(c), float(s), *box])
             idxs.append(fi)
     out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
     nums = Tensor(jnp.asarray(np.asarray(counts, np.int32)))

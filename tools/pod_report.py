@@ -25,12 +25,19 @@ import re
 import sys
 import time
 
-# TPU generation table: per-chip HBM and peak dense bf16 FLOP/s.
+# TPU generation table: per-chip HBM, peak dense bf16 FLOP/s, and the
+# per-core VMEM the Level-3 kernel verifier budgets Pallas blocks
+# against (~16 MiB physical minus Mosaic spill/prologue headroom;
+# double on v6e).
 TPU_GENERATIONS = {
-    "v4":  dict(hbm_gib=32.0,  peak_flops=275e12, ici_gbps=100.0),
-    "v5e": dict(hbm_gib=16.0,  peak_flops=197e12, ici_gbps=50.0),
-    "v5p": dict(hbm_gib=95.0,  peak_flops=459e12, ici_gbps=100.0),
-    "v6e": dict(hbm_gib=32.0,  peak_flops=918e12, ici_gbps=100.0),
+    "v4":  dict(hbm_gib=32.0,  peak_flops=275e12, ici_gbps=100.0,
+                vmem_mib=12),
+    "v5e": dict(hbm_gib=16.0,  peak_flops=197e12, ici_gbps=50.0,
+                vmem_mib=12),
+    "v5p": dict(hbm_gib=95.0,  peak_flops=459e12, ici_gbps=100.0,
+                vmem_mib=12),
+    "v6e": dict(hbm_gib=32.0,  peak_flops=918e12, ici_gbps=100.0,
+                vmem_mib=24),
 }
 
 _MESH_RE = re.compile(r"^(?P<gen>[a-z0-9]+)-(?P<n>\d+)$")
@@ -283,6 +290,7 @@ def build_report(args):
             "hbm_utilization": round(peak / hbm_bytes, 4),
         },
         "collectives": _collectives_of(compiled),
+        "kernels": _kernel_section(gen),
         "predicted": {
             "step_time_ms": round(pred_step_us / 1e3, 3),
             "mfu": round(mfu, 4),
@@ -299,6 +307,38 @@ def build_report(args):
             "bytes_accessed": profile["bytes_accessed"],
         },
         "notes": _plan_notes(n_dev),
+    }
+
+
+def _kernel_section(gen):
+    """Level-3 kernel verifier sweep for the report: trace the
+    registered Pallas kernel library (CPU-only, nothing executes) with
+    this generation's per-core VMEM budget and report per-kernel block
+    footprints + verdicts. None when the analysis package is missing."""
+    try:
+        from paddle_tpu.analysis import kernel_checks
+        from paddle_tpu.profiler import xmem
+    except ImportError:
+        return None
+    budget = int(gen["vmem_mib"]) << 20
+    try:
+        findings = kernel_checks.verify_registered(
+            config={"vmem_budget_bytes": budget})
+        n_cases = len(kernel_checks.registered_cases())
+    except Exception as e:  # a broken kernel library must not kill the fit report
+        return {"error": f"{type(e).__name__}: {e}"}
+    ests = xmem.kernel_estimates()
+    return {
+        "vmem_budget_mib": int(gen["vmem_mib"]),
+        "cases_verified": n_cases,
+        "estimates": [
+            dict(kernel=e["kernel"],
+                 vmem_bytes=e["vmem_bytes"],
+                 vmem_mib=round(e["vmem_bytes"] / 2**20, 2),
+                 within_budget=e["vmem_bytes"] <= budget)
+            for e in ests[:16]],
+        "findings": [f.to_dict() for f in findings],
+        "ok": not any(f.severity == "error" for f in findings),
     }
 
 

@@ -10,15 +10,24 @@ Usage:
     python tools/tpu_lint.py paddle_tpu/ --baseline-update
     python tools/tpu_lint.py some_file.py --no-baseline
     python tools/tpu_lint.py paddle_tpu/ --rules except-pass
+    python tools/tpu_lint.py paddle_tpu/ --kernels      # + Level-3 sweep
+    python tools/tpu_lint.py paddle_tpu/ --format=github
 
 Output: a JSON document on stdout — every finding carries severity,
-rule id, and file:line. Exit codes: 0 clean against the baseline,
-1 new warning-level findings, 2 new error-level findings.
+rule id, and file:line (``--format=github`` emits ::error/::warning
+workflow annotations instead). Exit codes: 0 clean against the
+baseline, 1 new warning-level findings, 2 new error-level findings.
+
+``--kernels`` additionally runs the Level-3 kernel verifier over the
+registered kernel library (ops/pallas_ops.py) and over any given .py
+path exposing a ``kernel_verify_cases()`` hook. This is the one mode
+that imports jax (kernels are traced, never executed — CPU is enough).
 
 The jaxpr rule family runs at trace time instead — enable it with
 ``to_static(..., lint=True)`` or ``FLAGS_tpu_lint=1`` (see
-docs/static_analysis.md). This CLI stays jax-free so it starts in
-milliseconds: the analysis package is loaded standalone.
+docs/static_analysis.md). Without ``--kernels`` this CLI stays
+jax-free so it starts in milliseconds: the analysis package is loaded
+standalone.
 """
 from __future__ import annotations
 
@@ -68,6 +77,17 @@ def main(argv=None) -> int:
                          "(default: the repo root)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also run the Level-3 kernel verifier: the "
+                         "registered kernel library plus any given .py "
+                         "path exposing a kernel_verify_cases() hook "
+                         "(imports jax; kernels are traced on CPU, "
+                         "never executed)")
+    ap.add_argument("--format", choices=("json", "github"),
+                    default="json",
+                    help="output format: the JSON document (default) or "
+                         "GitHub workflow ::error/::warning annotations "
+                         "for the NEW findings")
     args = ap.parse_args(argv)
 
     analysis = _load_analysis()
@@ -78,12 +98,33 @@ def main(argv=None) -> int:
         catalogue.update(
             {rid: {"severity": sev, "doc": doc, "level": "jaxpr"}
              for rid, (sev, fn, doc) in analysis.JAXPR_RULES.items()})
+        catalogue.update(
+            {rid: {"severity": sev, "doc": doc, "level": "spmd"}
+             for rid, (sev, doc) in analysis.SPMD_RULES.items()})
+        catalogue.update(
+            {rid: {"severity": sev, "doc": doc, "level": "kernel"}
+             for rid, (sev, doc) in analysis.KERNEL_RULES.items()})
         print(json.dumps(catalogue, indent=2, sort_keys=True))
         return 0
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules \
         else None
-    findings = analysis.check_paths(args.paths, rules=rules)
+    findings = list(analysis.check_paths(args.paths, rules=rules))
+
+    kernel_cases = 0
+    if args.kernels:
+        # the one jax-paying mode: repo root on sys.path so the real
+        # paddle_tpu package (and its kernel registry) is importable
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        kc = analysis.kernel_checks
+        findings.extend(kc.verify_registered(rules=rules))
+        kernel_cases = len(kc.registered_cases())
+        for p in args.paths:
+            if p.endswith(".py") and os.path.isfile(p):
+                fs, n = kc.verify_module(p, rules=rules)
+                findings.extend(fs)
+                kernel_cases += n
 
     baseline_path = args.baseline or (
         DEFAULT_BASELINE if os.path.isfile(DEFAULT_BASELINE) else None)
@@ -117,12 +158,54 @@ def main(argv=None) -> int:
         "fixed": fixed,
         "ok": not new,
     }
-    print(json.dumps(doc, indent=2))
+    if args.kernels:
+        doc["kernel_cases"] = kernel_cases
+    if args.format == "github":
+        for line in _github_annotations(new, fixed, args.root):
+            print(line)
+    else:
+        print(json.dumps(doc, indent=2))
     if new_errors:
         return 2
     if new:
         return 1
     return 0
+
+
+def _gh_escape(s: str, data: bool = True) -> str:
+    """GitHub workflow-command escaping: %, CR, LF always; , and : only
+    in property values."""
+    s = s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if not data:
+        s = s.replace(",", "%2C").replace(":", "%3A")
+    return s
+
+
+def _github_annotations(new, fixed, root):
+    """``::error file=...,line=...::[rule] message`` lines for the NEW
+    findings (what a CI run should flag on the PR), plus one summary
+    ::notice."""
+    lines = []
+    for f in new:
+        level = "error" if f.severity == "error" else "warning"
+        props = []
+        if f.file:
+            path = f.file
+            try:
+                rel = os.path.relpath(path, root)
+                if not rel.startswith(".."):
+                    path = rel
+            except ValueError:
+                pass
+            props.append("file=" + _gh_escape(path, data=False))
+            if f.line:
+                props.append(f"line={int(f.line)}")
+        head = f"::{level} " + ",".join(props) if props else f"::{level}"
+        lines.append(f"{head}::" + _gh_escape(f"[{f.rule}] {f.message}"))
+    lines.append("::notice::" + _gh_escape(
+        f"tpu_lint: {len(new)} new finding(s), {len(fixed)} fixed "
+        "vs baseline"))
+    return lines
 
 
 if __name__ == "__main__":
