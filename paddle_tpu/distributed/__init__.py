@@ -29,7 +29,9 @@ from . import fleet
 from . import sharding
 from . import checkpoint
 from . import fault_tolerance
+from . import reshard
 from .fault_tolerance import CheckpointManager, PreemptionHandler
+from .reshard import restore_resharded
 from . import pipeline
 from . import rpc
 from . import auto_parallel
@@ -56,6 +58,7 @@ __all__ = [
     "model_parallel_random_seed", "fleet", "sharding", "spawn", "launch",
     "recompute", "recompute_sequential", "pipeline", "rpc", "auto_parallel",
     "fault_tolerance", "CheckpointManager", "PreemptionHandler",
+    "reshard", "restore_resharded",
 ]
 
 

@@ -111,13 +111,15 @@ def abstract_like(tree):
 # ---------------------------------------------------------------------------
 
 def _finalize(tmp: str, final: str, t0: float, step: Optional[int],
-              root: Optional[str], keep: Optional[int]):
+              root: Optional[str], keep: Optional[int],
+              extra: Optional[dict] = None):
     """Publish a durable tmp dir: manifest -> atomic rename -> metrics ->
     inflight bookkeeping -> pruning. Runs inline for sync saves, on the
     waiter thread for async ones (so pruning naturally waits on them)."""
     try:
         chaos_point("ckpt.commit.pre", step=step, path=final)
-        extra = {"step": step} if step is not None else None
+        if extra is None:
+            extra = {"step": step} if step is not None else None
         man = ft.commit_dir(tmp, final, overwrite=True, extra=extra)
         chaos_point("ckpt.commit.post", step=step, path=final)
         ft.record_save(time.perf_counter() - t0, man["bytes_total"],
@@ -134,7 +136,8 @@ def _finalize(tmp: str, final: str, t0: float, step: Optional[int],
 
 def _save_impl(final: str, tree: Any, *, overwrite: bool, sync: bool,
                step: Optional[int] = None, root: Optional[str] = None,
-               keep: Optional[int] = None) -> None:
+               keep: Optional[int] = None,
+               extra: Optional[dict] = None) -> None:
     if os.path.exists(final) and not overwrite:
         raise FileExistsError(final)
     os.makedirs(os.path.dirname(final) or ".", exist_ok=True)
@@ -156,7 +159,7 @@ def _save_impl(final: str, tree: Any, *, overwrite: bool, sync: bool,
         raise
     if sync:
         ckptr.wait_until_finished()
-        _finalize(tmp, final, t0, step, root, keep)
+        _finalize(tmp, final, t0, step, root, keep, extra)
         return
 
     def _wait_and_commit():
@@ -164,7 +167,7 @@ def _save_impl(final: str, tree: Any, *, overwrite: bool, sync: bool,
             # waits for ALL pending orbax ops — ours included; a later
             # save's data becoming durable first is harmless
             _checkpointer().wait_until_finished()
-            _finalize(tmp, final, t0, step, root, keep)
+            _finalize(tmp, final, t0, step, root, keep, extra)
         except BaseException as e:  # surfaced by wait_until_finished()
             with _ASYNC_LOCK:
                 _ASYNC_ERRORS.append(e)
@@ -217,15 +220,16 @@ def _step_dir(root: str, step: int) -> str:
 
 
 def save_step(root: str, state: Any, step: int, *, keep: int = 3,
-              sync: bool = True) -> str:
+              sync: bool = True, extra: Optional[dict] = None) -> str:
     """Save an arbitrary pytree under root/step_N with the commit
     protocol, pruning old committed steps (keep=0 keeps all). Pruning
     skips steps still streaming in async saves and never removes the
-    newest committed step."""
+    newest committed step. ``extra`` replaces the default ``{"step"}``
+    manifest extras (topology/RNG/data state from CheckpointManager)."""
     root_abs = os.path.abspath(root)
     d = _step_dir(root, step)
     _save_impl(d, state, overwrite=True, sync=sync, step=step,
-               root=root_abs, keep=keep)
+               root=root_abs, keep=keep, extra=extra)
     return d
 
 

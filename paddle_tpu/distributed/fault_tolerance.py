@@ -52,10 +52,11 @@ from ..testing.chaos import chaos_point
 
 __all__ = [
     "RELAUNCH_EXIT_CODE", "MANIFEST_NAME", "TMP_SUFFIX", "OLD_SUFFIX",
-    "CheckpointCorruptionError", "write_manifest", "read_manifest",
-    "is_committed", "verify_dir", "commit_dir", "recover_dir",
-    "step_dir_name", "committed_steps", "latest_committed_step",
-    "prune_steps", "backoff_delays", "retry_with_backoff",
+    "CheckpointCorruptionError", "VersionSkewError", "write_manifest",
+    "read_manifest", "is_committed", "verify_dir", "commit_dir",
+    "recover_dir", "step_dir_name", "committed_steps",
+    "latest_committed_step", "prune_steps", "pin_step", "unpin_step",
+    "pinned_steps", "backoff_delays", "retry_with_backoff",
     "PreemptionHandler", "CheckpointManager", "record_save",
     "record_restore", "record_fallback", "summary_lines", "stats",
     "reset_stats",
@@ -75,6 +76,14 @@ _STEP_RE = re.compile(r"^step_(\d+)$")
 
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint directory failed manifest verification."""
+
+
+class VersionSkewError(RuntimeError):
+    """A checkpoint's recorded framework version differs from the
+    running one while version-sensitive state (per-rank RNG streams) is
+    being restored. RNG algorithms are allowed to change between
+    versions, so a silent restore could fork the dropout/data-aug
+    streams; pass ``allow_version_skew=True`` to restore anyway."""
 
 
 # ---------------------------------------------------------------------------
@@ -304,22 +313,56 @@ def latest_committed_step(root: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+# keep-anchor registry: steps an in-flight rewind or corruption
+# fallback could still target. CheckpointManager.restore pins every step
+# it successfully verifies+loads (the "last verified good" anchor), and
+# prune_steps refuses to delete a pinned step even when newer saves push
+# it out of the keep window.
+_PINNED: Dict[str, set] = {}
+_PINNED_LOCK = threading.Lock()
+
+
+def pin_step(root: str, step: int):
+    """Protect ``root/step_N`` from :func:`prune_steps` until unpinned."""
+    with _PINNED_LOCK:
+        _PINNED.setdefault(os.path.abspath(root), set()).add(int(step))
+
+
+def unpin_step(root: str, step: Optional[int] = None):
+    """Drop one pin (or every pin under ``root`` when step is None)."""
+    with _PINNED_LOCK:
+        pins = _PINNED.get(os.path.abspath(root))
+        if pins is None:
+            return
+        if step is None:
+            pins.clear()
+        else:
+            pins.discard(int(step))
+
+
+def pinned_steps(root: str) -> set:
+    with _PINNED_LOCK:
+        return set(_PINNED.get(os.path.abspath(root), ()))
+
+
 def prune_steps(root: str, keep: int,
                 inflight: Iterable[int] = ()) -> List[int]:
     """Drop old committed steps, keeping the newest ``keep`` (0 = keep
-    all). Never touches the latest committed step, steps an async save
-    is still writing, or their temp dirs; stale crash-leftover temp dirs
-    ARE swept. Returns the steps removed."""
+    all). Never touches the latest committed step, pinned steps
+    (:func:`pin_step` — the rewind/fallback keep-anchor), steps an async
+    save is still writing, or their temp dirs; stale crash-leftover temp
+    dirs ARE swept. Returns the steps removed."""
     root = os.path.abspath(root)
     if not os.path.isdir(root):
         return []
     inflight = set(inflight)
+    pinned = pinned_steps(root)
     removed = []
     steps = committed_steps(root)
     last = steps[-1] if steps else None
     victims = steps[:-keep] if keep else []
     for s in victims:
-        if s in inflight or s == last:
+        if s in inflight or s == last or s in pinned:
             continue
         shutil.rmtree(os.path.join(root, step_dir_name(s)),
                       ignore_errors=True)
@@ -582,7 +625,8 @@ class CheckpointManager:
 
     def __init__(self, root: str, *, save_interval_steps: int = 1,
                  keep: int = 3, backend: str = "orbax", sync: bool = False,
-                 preemption=False, state_file: str = "state.pdz"):
+                 preemption=False, state_file: str = "state.pdz",
+                 track_rng: bool = True):
         if backend not in ("orbax", "pickle"):
             raise ValueError(f"backend must be 'orbax' or 'pickle', "
                              f"got {backend!r}")
@@ -595,6 +639,8 @@ class CheckpointManager:
         self.backend = backend
         self.sync = bool(sync) or backend == "pickle"
         self.state_file = state_file
+        self.track_rng = bool(track_rng)
+        self._data_obj = None
         self._owns_handler = preemption is True
         if preemption is True:
             self._preempt: Optional[PreemptionHandler] = PreemptionHandler()
@@ -602,6 +648,36 @@ class CheckpointManager:
             self._preempt = preemption
         else:
             self._preempt = None
+
+    # -- data-pipeline tracking --------------------------------------------
+    def attach_data(self, obj) -> "CheckpointManager":
+        """Track a DataLoader / DistributedBatchSampler (anything with
+        ``state_dict``/``load_state_dict``). Every save then embeds its
+        state in the checkpoint manifest, and ``restore`` replays it —
+        sample-exact resume, valid across a dp resize because sampler
+        offsets are defined in global sample order."""
+        if obj is not None and not hasattr(obj, "state_dict"):
+            raise TypeError(
+                f"attach_data needs an object with state_dict/"
+                f"load_state_dict, got {type(obj).__name__}")
+        self._data_obj = obj
+        return self
+
+    def _manifest_extra(self, step: int, state: Any = None) -> dict:
+        """The topology/sharding/RNG/data-state block every committed
+        checkpoint carries (reshard.manifest_extra; failures degrade to
+        a bare {"step"} manifest rather than failing the save)."""
+        extra: Dict[str, Any] = {"step": step}
+        try:
+            from .reshard import manifest_extra
+            extra.update(manifest_extra(data=self._data_obj,
+                                        rng=self.track_rng, state=state))
+        except Exception as e:  # noqa: BLE001 — save must still commit
+            import sys as _sys
+            _sys.stderr.write(
+                f"checkpoint: manifest extras unavailable ({e}); "
+                f"saving step {step} without topology/rng state\n")
+        return extra
 
     # -- queries ------------------------------------------------------------
     @property
@@ -622,12 +698,16 @@ class CheckpointManager:
 
     # -- save / restore -----------------------------------------------------
     def save(self, step: int, state: Any, *, sync: Optional[bool] = None):
-        """Commit ``state`` as step ``step`` and prune old steps."""
+        """Commit ``state`` as step ``step`` and prune old steps. The
+        manifest carries the topology/sharding/RNG/data-pipeline block
+        (:meth:`attach_data`, ``track_rng``) so the checkpoint restores
+        onto a different world size with sample-exact data resume."""
         sync = self.sync if sync is None else sync
+        extra = self._manifest_extra(step, state)
         if self.backend == "orbax":
             from . import checkpoint as dckpt
             dckpt.save_step(self.root, state, step, keep=self.keep,
-                            sync=sync)
+                            sync=sync, extra=extra)
             return
         final = os.path.join(self.root, step_dir_name(step))
         tmp = final + TMP_SUFFIX
@@ -639,39 +719,71 @@ class CheckpointManager:
         chaos_point("ckpt.save.pre", step=step, path=final)
         fsave(state, os.path.join(tmp, self.state_file))
         chaos_point("ckpt.commit.pre", step=step, path=final)
-        man = commit_dir(tmp, final, extra={"step": step})
+        man = commit_dir(tmp, final, extra=extra)
         chaos_point("ckpt.commit.post", step=step, path=final)
         record_save(time.perf_counter() - t0, man["bytes_total"], step=step)
         prune_steps(self.root, self.keep)
 
-    def restore(self, target: Any = None,
-                step: Optional[int] = None) -> Tuple[Any, int]:
+    def _apply_manifest_state(self, step: int, *, apply_data: bool,
+                              apply_rng: bool, allow_version_skew: bool):
+        man = read_manifest(os.path.join(self.root, step_dir_name(step)))
+        if man is None:
+            return
+        from .reshard import apply_manifest_state
+        apply_manifest_state(
+            man, data=self._data_obj if apply_data else None,
+            rng=apply_rng and self.track_rng,
+            allow_version_skew=allow_version_skew)
+
+    def restore(self, target: Any = None, step: Optional[int] = None, *,
+                apply_data: bool = True, apply_rng: bool = True,
+                allow_version_skew: bool = False) -> Tuple[Any, int]:
         """(state, step) from the newest loadable committed step —
         falling back past corrupt ones — or (None, 0) when the run is
         fresh. ``target`` (orbax backend) re-shards onto the current
-        mesh."""
+        mesh.
+
+        The restored step is pinned (:func:`pin_step`) as the
+        last-verified-good anchor, so pruning can never delete the
+        checkpoint an in-flight rewind or corruption fallback targets.
+        When the manifest carries data-pipeline / RNG state it is
+        replayed into the attached loader and the framework RNG
+        (``apply_data``/``apply_rng``); RNG restore refuses a
+        framework-version skew unless ``allow_version_skew=True``."""
+        got: Optional[int] = None
+        state: Any = None
         if self.backend == "orbax":
             from . import checkpoint as dckpt
             try:
-                return dckpt.load_step(self.root, target, step=step)
+                state, got = dckpt.load_step(self.root, target, step=step)
             except FileNotFoundError:
                 return None, 0
-        candidates = [step] if step is not None else \
-            list(reversed(self.all_steps()))
-        for s in candidates:
-            d = os.path.join(self.root, step_dir_name(s))
-            try:
-                verify_dir(d)
-                from ..framework.io import load as fload
-                state = fload(os.path.join(d, self.state_file))
-            except (CheckpointCorruptionError, RuntimeError, OSError):
-                if step is not None:
-                    raise
-                record_fallback(s)
-                continue
-            record_restore(s)
-            return state, s
-        return None, 0
+        else:
+            candidates = [step] if step is not None else \
+                list(reversed(self.all_steps()))
+            for s in candidates:
+                d = os.path.join(self.root, step_dir_name(s))
+                try:
+                    verify_dir(d)
+                    from ..framework.io import load as fload
+                    state = fload(os.path.join(d, self.state_file))
+                except (CheckpointCorruptionError, RuntimeError, OSError):
+                    if step is not None:
+                        raise
+                    record_fallback(s)
+                    continue
+                got = s
+                break
+            if got is None:
+                return None, 0
+            record_restore(got)
+        self._apply_manifest_state(
+            got, apply_data=apply_data, apply_rng=apply_rng,
+            allow_version_skew=allow_version_skew)
+        # one anchor per root: the newest verified-good step
+        unpin_step(self.root)
+        pin_step(self.root, got)
+        return state, got
 
     # -- train-loop hook ----------------------------------------------------
     def step_end(self, step: int, state: Any) -> bool:

@@ -130,6 +130,10 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
         self._is_iterable = isinstance(dataset, IterableDataset)
+        # sample-exact resume bookkeeping: the sampler state at epoch
+        # start plus a consumer-side yield count (see state_dict)
+        self._active_state = None
+        self._yielded = 0
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
             self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
@@ -149,6 +153,13 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def __iter__(self):
+        if self.batch_sampler is not None and \
+                hasattr(self.batch_sampler, "state_dict"):
+            # snapshot BEFORE any dispatch: _iter_multi materializes the
+            # whole sampler upfront for its prefetch workers, which runs
+            # the sampler's own cursor to epoch end immediately
+            self._active_state = dict(self.batch_sampler.state_dict())
+            self._yielded = 0
         if self._is_iterable:
             it = self._iter_iterable()
         elif self.num_workers == 0:
@@ -168,13 +179,18 @@ class DataLoader:
             it = iter(DeviceDataLoader(it, self.places[0], buffer_size=buf))
         return self._instrumented(it)
 
-    @staticmethod
-    def _instrumented(it):
+    def _instrumented(self, it):
         """Telemetry around next-batch: a host span when a profiler is
         live, and fetch-latency histogram + batch counter when
         FLAGS_tpu_metrics is on. Fetch time here is consumer-side stall
         — with prefetch ahead of the consumer it should stay near zero;
-        a hot dataloader_next_seconds histogram means input-bound."""
+        a hot dataloader_next_seconds histogram means input-bound.
+
+        Also the resume cursor's counting point: a batch counts as
+        consumed the moment it is handed to the consumer (who will train
+        on it before checkpointing), NOT when a prefetch worker decodes
+        it — so ``state_dict`` stays exact however far prefetch ran
+        ahead."""
         import time as _time
         from ..profiler import _record_span, metrics as _metrics
         try:
@@ -185,6 +201,7 @@ class DataLoader:
                     with _record_span("dataloader_next"):
                         batch = next(it)
                 except StopIteration:
+                    self._active_state = None  # epoch drained cleanly
                     return
                 if rec:
                     _metrics.counter("dataloader_batches_total",
@@ -193,6 +210,7 @@ class DataLoader:
                         "dataloader_next_seconds",
                         "Consumer-side wait per batch").observe(
                             _time.perf_counter() - t0)
+                self._yielded += 1
                 yield batch
         finally:
             # an early consumer break must tear down worker processes
@@ -201,6 +219,42 @@ class DataLoader:
             close = getattr(it, "close", None)
             if close is not None:
                 close()
+
+    # -- sample-exact resume ------------------------------------------------
+    def state_dict(self) -> dict:
+        """The resume cursor (epoch + consumed GLOBAL sample offset +
+        shuffle RNG derivation), exact mid-epoch: the sampler state
+        snapshotted at epoch start advanced by the batches actually
+        handed to the consumer. Requires a batch_sampler with
+        ``state_dict`` (DistributedBatchSampler); CheckpointManager
+        embeds this in every commit manifest via ``attach_data`` and
+        replays it on restore."""
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "state_dict"):
+            raise TypeError(
+                "DataLoader.state_dict needs a batch_sampler exposing "
+                "state_dict/load_state_dict (io.DistributedBatchSampler); "
+                f"got {type(bs).__name__}")
+        if self._active_state is None:
+            return dict(bs.state_dict())
+        st = dict(self._active_state)
+        gbs = int(st.get("global_batch_size",
+                         getattr(bs, "global_batch_size", self.batch_size)))
+        st["offset"] = int(st.get("offset", 0)) + self._yielded * gbs
+        return st
+
+    def load_state_dict(self, state: dict):
+        """Resume the underlying sampler from a cursor — valid across an
+        elastic dp resize because offsets are in global sample order."""
+        bs = self.batch_sampler
+        if bs is None or not hasattr(bs, "load_state_dict"):
+            raise TypeError(
+                "DataLoader.load_state_dict needs a batch_sampler exposing "
+                "state_dict/load_state_dict (io.DistributedBatchSampler); "
+                f"got {type(bs).__name__}")
+        bs.load_state_dict(dict(state))
+        self._active_state = None
+        self._yielded = 0
 
     def _iter_iterable(self):
         batch = []

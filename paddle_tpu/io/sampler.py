@@ -106,10 +106,21 @@ class BatchSampler(Sampler):
 class DistributedBatchSampler(BatchSampler):
     """Per-rank sharded batches (reference:
     python/paddle/fluid/dataloader/batch_sampler.py:DistributedBatchSampler).
-    On TPU a "rank" is a data-parallel host process (jax.process_index)."""
+    On TPU a "rank" is a data-parallel host process (jax.process_index).
+
+    Partitioning is defined in GLOBAL sample order: epoch ``e``'s order
+    is ``permutation(seed + e)`` (or arange), chunked into global
+    batches of ``nranks * batch_size``, and rank ``r`` takes the
+    contiguous slice ``[r*batch_size : (r+1)*batch_size]`` of each
+    chunk. The resume cursor (``state_dict``) is therefore a single
+    *global* sample offset — the consumed prefix of the epoch's order —
+    which stays exact when a checkpoint written at world size N resumes
+    at world size M (elastic dp resize): no sample is replayed or
+    skipped as long as the global batch size is preserved.
+    """
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
-                 shuffle=False, drop_last=False):
+                 shuffle=False, drop_last=False, seed=0):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -122,32 +133,80 @@ class DistributedBatchSampler(BatchSampler):
             rank = jax.process_index()
         self.nranks = num_replicas
         self.local_rank = rank
+        self.seed = int(seed)
         self.epoch = 0
+        self._offset = 0  # global samples consumed in the current epoch
         self.num_samples = int(math.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
 
-    def __iter__(self):
+    @property
+    def global_batch_size(self):
+        return self.batch_size * self.nranks
+
+    def _global_order(self, epoch):
+        """Epoch ``epoch``'s global sample order, padded (wrapping) to a
+        whole number of global batches — or truncated under drop_last."""
         n = len(self.dataset)
-        indices = np.arange(n).tolist()
         if self.shuffle:
-            rng = np.random.RandomState(self.epoch)
-            indices = rng.permutation(n).tolist()
-            self.epoch += 1
-        indices += indices[:(self.total_size - len(indices))]
-        local = indices[self.local_rank::self.nranks]
-        batch = []
-        for idx in local:
-            batch.append(idx)
-            if len(batch) == self.batch_size:
+            rng = np.random.RandomState(self.seed + int(epoch))
+            order = rng.permutation(n).tolist()
+        else:
+            order = list(range(n))
+        gbs = self.global_batch_size
+        if self.drop_last:
+            return order[:(n // gbs) * gbs]
+        pad = (-n) % gbs
+        while pad > 0:
+            take = min(pad, n)
+            order += order[:take]
+            pad -= take
+        return order
+
+    def __iter__(self):
+        epoch = self.epoch
+        order = self._global_order(epoch)
+        gbs = self.global_batch_size
+        lo = self.local_rank * self.batch_size
+        g0 = self._offset
+        while g0 < len(order):
+            chunk = order[g0:g0 + gbs]
+            g0 = min(g0 + gbs, len(order))
+            # the cursor advances as batches are handed out: a state_dict
+            # captured after training on batch b resumes at b+1
+            self._offset = g0
+            batch = chunk[lo:lo + self.batch_size]
+            if batch:
                 yield batch
-                batch = []
-        if batch and not self.drop_last:
-            yield batch
+        self._offset = 0
+        if self.shuffle:
+            self.epoch = epoch + 1
 
     def __len__(self):
+        n = len(self.dataset)
+        gbs = self.global_batch_size
         if self.drop_last:
-            return self.num_samples // self.batch_size
-        return (self.num_samples + self.batch_size - 1) // self.batch_size
+            return n // gbs
+        return (n + gbs - 1) // gbs
 
     def set_epoch(self, epoch):
-        self.epoch = epoch
+        self.epoch = int(epoch)
+        self._offset = 0
+
+    # -- sample-exact resume ------------------------------------------------
+    def state_dict(self):
+        """The resume cursor: epoch, consumed GLOBAL sample offset, and
+        the shuffle RNG derivation (seed; the permutation is a pure
+        function of ``seed + epoch``). JSON-able — CheckpointManager
+        embeds it in the commit manifest (``attach_data``)."""
+        return {"epoch": int(self.epoch), "offset": int(self._offset),
+                "seed": int(self.seed), "shuffle": bool(self.shuffle),
+                "global_batch_size": int(self.global_batch_size)}
+
+    def load_state_dict(self, state):
+        """Resume from a cursor — possibly written at a different world
+        size: the offset is global, so only ``global_batch_size`` needs
+        to be preserved across the resize for sample-exactness."""
+        self.epoch = int(state.get("epoch", 0))
+        self._offset = int(state.get("offset", 0))
+        if "seed" in state:
+            self.seed = int(state["seed"])

@@ -9,18 +9,21 @@ from __future__ import annotations
 
 from typing import List
 
-from . import watchdog, health  # noqa: F401
+from . import watchdog, health, rewind  # noqa: F401
 from .watchdog import (PhaseTimeout, Watchdog, run_with_deadline,  # noqa: F401
                        init_with_retries, incidents, last_incident,
                        record_incident, clear_incidents)
 from .health import (CollectiveTimeout, HealthMonitor,  # noqa: F401
                      collective_beacon, record_fused_fallback)
+from .rewind import (RewindBudgetExceeded, RewindResult,  # noqa: F401
+                     RewindGuard)
 
-__all__ = ["watchdog", "health", "PhaseTimeout", "Watchdog",
+__all__ = ["watchdog", "health", "rewind", "PhaseTimeout", "Watchdog",
            "run_with_deadline", "init_with_retries", "incidents",
            "last_incident", "record_incident", "clear_incidents",
            "CollectiveTimeout", "HealthMonitor", "collective_beacon",
-           "record_fused_fallback", "summary_lines"]
+           "record_fused_fallback", "RewindBudgetExceeded", "RewindResult",
+           "RewindGuard", "summary_lines"]
 
 
 def summary_lines() -> List[str]:

@@ -26,7 +26,11 @@ Gang-aware options: ``rank=`` fires only on that trainer
 generation (``PADDLE_RESTART_COUNT``) — so ``hang@collective.
 all_reduce:step=3,restart=0`` hangs the first generation and lets the
 relaunched one run clean, matched at fire time because the env is
-inherited by every rank and every generation.
+inherited by every rank and every generation. ``resize=N`` publishes an
+elastic scale request to the gang's launcher just before the action
+fires, so ``crash@train.step:step=5,restart=0,resize=2`` kills a
+4-worker generation and brings the job back with 2 — the
+preempted-then-smaller-slice relaunch the reshard layer exists for.
 
 Schedules are deterministic: rules match on point name (fnmatch
 pattern), optional ``step``, fire at most ``times`` times after skipping
@@ -78,7 +82,8 @@ class Rule:
                  frac: float = 0.5, secs: Optional[float] = None,
                  sleep_s: Optional[float] = None,
                  rank: Optional[int] = None,
-                 restart: Optional[int] = None):
+                 restart: Optional[int] = None,
+                 resize: Optional[int] = None):
         if action not in ACTIONS:
             raise ValueError(f"unknown chaos action {action!r}; "
                              f"one of {ACTIONS}")
@@ -99,10 +104,14 @@ class Rule:
         self.secs = None if secs is None else float(secs)
         self.rank = None if rank is None else int(rank)
         self.restart = None if restart is None else int(restart)
+        self.resize = None if resize is None else int(resize)
+        if self.resize is not None and self.resize < 1:
+            raise ValueError(f"resize={self.resize} must be >= 1")
         self.hits = 0    # matching visits (post step-filter)
         self.fired = 0   # times the fault actually fired
 
-    _INT_KEYS = {"step", "times", "after", "exit_code", "rank", "restart"}
+    _INT_KEYS = {"step", "times", "after", "exit_code", "rank", "restart",
+                 "resize"}
     _FLOAT_KEYS = {"prob", "frac", "sleep_s", "secs"}
 
     @classmethod
@@ -180,6 +189,8 @@ class Chaos:
             self._fire(r, point, step, path)
 
     def _fire(self, r: Rule, point: str, step, path):
+        if r.resize is not None:
+            _request_resize(r.resize)
         if r.action == "crash":
             # the real thing: no cleanup, no atexit, no flush — exactly
             # what a preempted VM or OOM-killed worker looks like
@@ -205,6 +216,24 @@ class Chaos:
         if r.action == "truncate":
             if path and os.path.isfile(path):
                 truncate_file(path, keep_frac=r.frac)
+
+
+def _request_resize(nproc: int):
+    """The elastic-resize relaunch filter: before the rule's action
+    fires, publish a scale request to this gang's launcher
+    (``fleet.elastic.request_scale`` on the PADDLE_MASTER store), so a
+    ``crash@train.step:step=k,resize=2`` kill is relaunched at world
+    size 2 — the preempted-pod-replaced-by-a-smaller-slice shape the
+    elastic reshard E2E proves out."""
+    master = os.environ.get("PADDLE_MASTER")
+    job_id = os.environ.get("PADDLE_JOB_ID", "default")
+    if not master:
+        raise RuntimeError(
+            "chaos resize= needs a launcher rendezvous (PADDLE_MASTER "
+            "unset): run under `python -m paddle_tpu.distributed.launch "
+            "--elastic`")
+    from ..distributed.fleet.elastic import request_scale
+    request_scale(master, job_id, int(nproc))
 
 
 _ACTIVE: Optional[Chaos] = None

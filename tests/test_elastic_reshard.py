@@ -1,0 +1,590 @@
+"""Elastic resharding restore, sample-exact data resume, anomaly rewind,
+and the ckpt_inspect CLI — fast units (the cross-process resize E2E lives
+in test_elastic_reshard_e2e.py).
+
+The slicing math is cross-checked against jax's own
+NamedSharding.devices_indices_map, so reshard.py cannot drift from
+GSPMD's layout convention without failing here.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu  # noqa: F401 — registers the Tensor pytree
+from paddle_tpu.distributed import fault_tolerance as ft
+from paddle_tpu.distributed import reshard
+from paddle_tpu.io.dataloader import DataLoader
+from paddle_tpu.io.sampler import DistributedBatchSampler
+from paddle_tpu.runtime import (RewindBudgetExceeded, RewindGuard,
+                                clear_incidents, incidents)
+from paddle_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INSPECT = os.path.join(REPO, "tools", "ckpt_inspect.py")
+
+
+def _mesh(shape, axes):
+    n = int(np.prod(shape))
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), axes)
+
+
+def _norm(slices, shape):
+    """(start, stop) per dim with None/defaults resolved."""
+    return tuple(sl.indices(dim)[:2] for sl, dim in zip(slices, shape))
+
+
+class _ArrayDataset:
+    """(x, y, sample_id) triples over a deterministic regression set."""
+
+    def __init__(self, n=48, d=4, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, d)).astype(np.float32)
+        w = rng.standard_normal((d,)).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i], np.int64(i)
+
+
+# ---------------------------------------------------------------------------
+# sharding-spec serialization + slicing math
+# ---------------------------------------------------------------------------
+
+def test_spec_json_round_trip():
+    spec = P("dp", ("mp", "pp"), None)
+    j = reshard.spec_to_json(spec)
+    assert j == [["dp"], ["mp", "pp"], None]
+    assert reshard.spec_from_json(j) == spec
+    assert reshard.spec_from_json(None) == P()
+    # json round-trips through an actual manifest encode
+    assert json.loads(json.dumps(j)) == j
+
+
+@pytest.mark.parametrize("shape,spec,spec_json", [
+    ((8, 4), P("dp", "mp"), [["dp"], ["mp"]]),
+    ((8,), P(("dp", "mp")), [["dp", "mp"]]),
+    ((4, 4), P(None, "mp"), [None, ["mp"]]),
+    ((8, 2), P("dp"), [["dp"]]),
+])
+def test_slice_matches_jax_indices_map(shape, spec, spec_json):
+    """reshard's pure-numpy slices == NamedSharding.devices_indices_map,
+    device by device — the GSPMD row-major multi-axis convention."""
+    mesh = _mesh((4, 2), ("dp", "mp"))
+    dims = {"dp": 4, "mp": 2}
+    imap = NamedSharding(mesh, spec).devices_indices_map(shape)
+    for i in range(4):
+        for j in range(2):
+            dev = mesh.devices[i, j]
+            got = reshard.slice_for_shard(shape, spec_json, dims,
+                                          {"dp": i, "mp": j})
+            assert _norm(got, shape) == _norm(imap[dev], shape), (
+                f"coords dp={i},mp={j}")
+
+
+def test_reslice_gather_round_trip_across_meshes():
+    full = np.arange(8 * 6, dtype=np.float32).reshape(8, 6)
+    spec = [["dp"], ["mp"]]
+    a = {"dp": 4, "mp": 2}
+    b = {"dp": 2, "mp": 2}
+    shards_a = reshard.reslice(full, spec, a)
+    assert len(shards_a) == 8
+    assert all(s.shape == (2, 3) for s in shards_a.values())
+    back = reshard.gather_full(shards_a, full.shape, spec, a)
+    np.testing.assert_array_equal(back, full)
+    # save-on-A / load-on-B: gather A's shards, re-slice for B
+    shards_b = reshard.reslice(back, spec, b)
+    assert all(s.shape == (4, 3) for s in shards_b.values())
+    np.testing.assert_array_equal(
+        reshard.gather_full(shards_b, full.shape, spec, b), full)
+
+
+def test_slice_non_divisible_dim_raises():
+    with pytest.raises(ValueError, match="does not divide"):
+        reshard.slice_for_shard((6,), [["dp"]], {"dp": 4}, {"dp": 0})
+
+
+def test_gather_rejects_wrong_shard_shape():
+    spec, dims = [["dp"]], {"dp": 2}
+    shards = reshard.reslice(np.zeros((4, 2)), spec, dims)
+    key = next(iter(shards))
+    shards[key] = np.zeros((3, 2))
+    with pytest.raises(ValueError, match="expects"):
+        reshard.gather_full(shards, (4, 2), spec, dims)
+
+
+# ---------------------------------------------------------------------------
+# topology-elastic checkpoint restore (the tentpole)
+# ---------------------------------------------------------------------------
+
+def test_save_then_restore_resharded_onto_smaller_mesh(tmp_path):
+    """A checkpoint committed on a dp=4,mp=2 mesh restores bit-exactly
+    onto dp=2,mp=2 — shards re-cut host-side from the saved specs."""
+    root = str(tmp_path / "ckpt")
+    mesh_a = _mesh((4, 2), ("dp", "mp"))
+    w = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    b = np.arange(4, dtype=np.float32)
+    state = {
+        "w": jax.device_put(w, NamedSharding(mesh_a, P("dp", "mp"))),
+        "b": jax.device_put(b, NamedSharding(mesh_a, P())),
+    }
+    mgr = ft.CheckpointManager(root, backend="orbax", sync=True)
+    mgr.save(3, state)
+    mgr.wait()
+
+    man = ft.read_manifest(os.path.join(root, ft.step_dir_name(3)))
+    assert man["topology"]["world_size"] >= 1
+    assert man["shardings"]["['w']"]["spec"] == [["dp"], ["mp"]]
+    assert man["rng"]["framework"] is not None
+
+    mesh_b = _mesh((2, 2), ("dp", "mp"))
+    got, step = reshard.restore_resharded(root, mesh=mesh_b)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(got["w"]), w)
+    np.testing.assert_array_equal(np.asarray(got["b"]), b)
+    assert got["w"].sharding.mesh.shape == {"dp": 2, "mp": 2}
+    assert got["w"].sharding.spec == P("dp", "mp")
+    # each device holds only its slice on the NEW mesh (4x2 per shard)
+    assert {sh.data.shape for sh in got["w"].addressable_shards} == {(4, 2)}
+    # the restored step is pinned as the rewind anchor
+    assert 3 in ft.pinned_steps(root)
+    ft.unpin_step(root)
+
+
+def test_restore_resharded_drops_axes_missing_on_target_mesh(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mesh_a = _mesh((2, 2), ("dp", "mp"))
+    w = np.arange(16, dtype=np.float32).reshape(4, 4)
+    state = {"w": jax.device_put(w, NamedSharding(mesh_a, P("dp", "mp")))}
+    mgr = ft.CheckpointManager(root, backend="orbax", sync=True)
+    mgr.save(1, state)
+    mgr.wait()
+    mesh_dp_only = _mesh((4,), ("dp",))
+    got, _ = reshard.restore_resharded(root, mesh=mesh_dp_only)
+    np.testing.assert_array_equal(np.asarray(got["w"]), w)
+    # 'mp' does not exist there: that dim falls back to replicated
+    assert got["w"].sharding.spec == P("dp", None)
+    ft.unpin_step(root)
+
+
+def test_restore_resharded_empty_root_returns_fresh(tmp_path):
+    assert reshard.restore_resharded(str(tmp_path / "none")) == (None, 0)
+
+
+def test_manifest_replays_data_cursor_across_world_sizes(tmp_path):
+    """Pickle-backend manager: a cursor committed at nranks=4 resumes
+    sample-exact on a nranks=2 loader (same global batch size)."""
+    root = str(tmp_path / "ckpt")
+    ds = _ArrayDataset(n=48)
+    smp4 = DistributedBatchSampler(ds, 2, num_replicas=4, rank=0,
+                                   shuffle=True, seed=7)
+    loader4 = DataLoader(ds, batch_sampler=smp4)
+    mgr = ft.CheckpointManager(root, backend="pickle").attach_data(loader4)
+    it = iter(loader4)
+    next(it), next(it)  # two global batches consumed (gbs=8 -> offset 16)
+    mgr.save(2, {"w": np.zeros(4, np.float32)})
+    man = ft.read_manifest(os.path.join(root, ft.step_dir_name(2)))
+    assert man["data"] == {"epoch": 0, "offset": 16, "seed": 7,
+                           "shuffle": True, "global_batch_size": 8}
+
+    smp2 = DistributedBatchSampler(ds, 4, num_replicas=2, rank=0,
+                                   shuffle=True, seed=0)
+    loader2 = DataLoader(ds, batch_sampler=smp2)
+    mgr2 = ft.CheckpointManager(root, backend="pickle").attach_data(loader2)
+    state, got = mgr2.restore()
+    assert got == 2 and state is not None
+    order = smp2._global_order(0)  # seed replayed from the manifest
+    # rank 0 at bs=4 takes the first 4 of each global chunk of 8
+    assert next(iter(loader2.batch_sampler)) == order[16:20]
+    assert 2 in ft.pinned_steps(root)
+    ft.unpin_step(root)
+
+
+# ---------------------------------------------------------------------------
+# global-sample-order sampler + consumer-side DataLoader cursor
+# ---------------------------------------------------------------------------
+
+def test_sampler_ranks_partition_global_order():
+    ds = _ArrayDataset(n=48)
+    for nranks in (1, 2, 4):
+        bs = 8 // nranks
+        samplers = [DistributedBatchSampler(ds, bs, num_replicas=nranks,
+                                            rank=r, shuffle=True, seed=3)
+                    for r in range(nranks)]
+        order = samplers[0]._global_order(0)
+        per_rank = [list(s) for s in samplers]
+        assert len({len(b) for b in per_rank}) == 1
+        for step in range(len(per_rank[0])):
+            got = [i for r in range(nranks) for i in per_rank[r][step]]
+            assert got == order[step * 8:(step + 1) * 8], (nranks, step)
+
+
+def test_sampler_resume_across_resize_is_sample_exact():
+    ds = _ArrayDataset(n=48)
+    smp4 = DistributedBatchSampler(ds, 2, num_replicas=4, rank=1,
+                                   shuffle=True, seed=5)
+    order = smp4._global_order(0)
+    it = iter(smp4)
+    consumed = [next(it) for _ in range(3)]  # rank 1's share of 3 steps
+    st = smp4.state_dict()
+    assert st["offset"] == 24 and st["global_batch_size"] == 8
+
+    # resume the GLOBAL cursor at world size 2 (bs doubles: gbs constant)
+    rest = []
+    for r in range(2):
+        s = DistributedBatchSampler(ds, 4, num_replicas=2, rank=r,
+                                    shuffle=True, seed=5)
+        s.load_state_dict(st)
+        rest.append(list(s))
+    flat = [i for step in zip(*rest) for b in step for i in b]
+    assert flat == order[24:]                            # no skip
+    assert not set(flat) & {i for b in consumed for i in b}  # no replay
+    assert sorted(flat + [i for step in range(3) for i in
+                          order[step * 8:(step + 1) * 8]]) == sorted(order)
+
+
+def test_sampler_epoch_rollover_and_set_epoch():
+    ds = _ArrayDataset(n=32)
+    smp = DistributedBatchSampler(ds, 4, num_replicas=2, rank=0,
+                                  shuffle=True, seed=1)
+    list(smp)
+    st = smp.state_dict()
+    assert st == {"epoch": 1, "offset": 0, "seed": 1, "shuffle": True,
+                  "global_batch_size": 8}
+    assert smp._global_order(0) != smp._global_order(1)
+    smp.set_epoch(0)
+    assert smp.state_dict()["epoch"] == 0
+
+
+def test_dataloader_cursor_counts_consumed_batches(tmp_path):
+    ds = _ArrayDataset(n=48)
+    smp = DistributedBatchSampler(ds, 8, num_replicas=1, rank=0,
+                                  shuffle=True, seed=2)
+    loader = DataLoader(ds, batch_sampler=smp)
+    order = smp._global_order(0)
+    it = iter(loader)
+    for _ in range(3):
+        next(it)
+    st = loader.state_dict()
+    assert st["offset"] == 24 and st["epoch"] == 0
+    # drain: cursor rolls to the next epoch
+    for _ in it:
+        pass
+    assert loader.state_dict() == smp.state_dict()
+    assert loader.state_dict()["epoch"] == 1
+
+    loader.load_state_dict(st)
+    batch = next(iter(loader))
+    ids = np.asarray(batch[2].numpy() if hasattr(batch[2], "numpy")
+                     else batch[2]).astype(int).tolist()
+    assert ids == order[24:32]
+
+
+def test_dataloader_cursor_exact_with_prefetch_runahead():
+    """_iter_multi materializes the whole sampler upfront for its
+    workers; the resume cursor must count CONSUMED batches, not
+    dispatched ones."""
+    ds = _ArrayDataset(n=32)
+    smp = DistributedBatchSampler(ds, 8, num_replicas=1, rank=0,
+                                  shuffle=True, seed=4)
+    loader = DataLoader(ds, batch_sampler=smp, num_workers=1)
+    it = iter(loader)
+    next(it)
+    # the sampler's own cursor ran to epoch end at dispatch time...
+    assert smp.state_dict() == {"epoch": 1, "offset": 0, "seed": 4,
+                                "shuffle": True, "global_batch_size": 8}
+    # ...but the loader's cursor says exactly one batch consumed
+    assert loader.state_dict()["offset"] == 8
+    assert loader.state_dict()["epoch"] == 0
+    for _ in it:  # drain so worker teardown happens inside the test
+        pass
+
+
+def test_dataloader_state_requires_stateful_sampler():
+    loader = DataLoader(_ArrayDataset(n=8), batch_size=2)
+    with pytest.raises(TypeError, match="state_dict"):
+        loader.state_dict()
+    with pytest.raises(TypeError, match="load_state_dict"):
+        loader.load_state_dict({"offset": 0})
+
+
+# ---------------------------------------------------------------------------
+# RNG manifest block + version-skew validation
+# ---------------------------------------------------------------------------
+
+def test_rng_bundle_round_trip(tmp_path):
+    from paddle_tpu.framework import random as frandom
+    from paddle_tpu.distributed import random as drandom
+    root = str(tmp_path / "ckpt")
+    frandom.seed(99)
+    frandom.next_key()  # counter != 0: the state is mid-stream
+    tracker = drandom.get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("mp_dropout", 123)
+    with tracker.rng_state("mp_dropout"):
+        frandom.next_key()  # advance the named stream too
+    mgr = ft.CheckpointManager(root, backend="pickle")
+    mgr.save(1, {"w": np.zeros(2, np.float32)})
+    saved_fw = frandom.get_rng_state()
+    saved_tr = tracker.get_states_tracker()["mp_dropout"].get_state()
+
+    frandom.seed(7)  # diverge everything
+    tracker.states_.clear()
+    mgr.restore()
+    assert frandom.get_rng_state() == saved_fw
+    assert tracker.get_states_tracker()["mp_dropout"].get_state() == saved_tr
+    ft.unpin_step(root)
+
+
+def test_version_skew_refused_then_overridable(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = ft.CheckpointManager(root, backend="pickle")
+    mgr.save(1, {"w": np.zeros(2, np.float32)})
+    # forge a checkpoint written by another framework version (the
+    # manifest itself is not a payload file, so no CRC to fix up)
+    mpath = os.path.join(root, ft.step_dir_name(1), ft.MANIFEST_NAME)
+    with open(mpath) as f:
+        man = json.load(f)
+    man["framework_version"] = "0.0.1-other"
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+
+    with pytest.raises(ft.VersionSkewError, match="0.0.1-other"):
+        mgr.restore()
+    state, got = mgr.restore(allow_version_skew=True)
+    assert got == 1
+    state, got = mgr.restore(apply_rng=False)  # reseed-fresh path
+    assert got == 1
+    ft.unpin_step(root)
+
+
+# ---------------------------------------------------------------------------
+# anomaly rewind
+# ---------------------------------------------------------------------------
+
+def _train_setup(tmp_path, n=64, max_rewinds=2, **guard_kw):
+    ds = _ArrayDataset(n=n)
+    smp = DistributedBatchSampler(ds, 8, num_replicas=1, rank=0,
+                                  shuffle=True, seed=11)
+    loader = DataLoader(ds, batch_sampler=smp)
+    mgr = ft.CheckpointManager(str(tmp_path / "ckpt"), backend="pickle",
+                               keep=3).attach_data(loader)
+    guard = RewindGuard(mgr, data=loader, max_rewinds=max_rewinds,
+                        **guard_kw)
+    return ds, smp, loader, mgr, guard
+
+
+def test_rewind_recovers_nan_batch_training_loop(tmp_path):
+    """Full loop integration: NaN at step 5 -> restore step 4, skip the
+    poisoned batch window, trajectory continues without replaying it."""
+    clear_incidents()
+    ds, smp, loader, mgr, guard = _train_setup(tmp_path)
+    order = smp._global_order(0)
+    lr, w = 0.05, np.zeros(4, np.float32)
+    consumed, step, it = [], 0, iter(loader)
+    while step < 6:
+        batch = next(it)
+        xs, ys, ids = (np.asarray(b.numpy() if hasattr(b, "numpy") else b)
+                       for b in batch)
+        step += 1
+        err = xs @ w - ys
+        loss = float(np.mean(err ** 2))
+        if step == 5 and guard.rewinds == 0:
+            loss = float("nan")  # poisoned batch
+        rw = guard.check(step, loss)
+        if rw is not None:
+            w, step = np.asarray(rw.state["w"]), rw.step
+            it = iter(loader)  # fresh iterator from the restored cursor
+            continue
+        w = w - lr * (2.0 * xs.T @ err / len(xs))
+        consumed.extend(ids.astype(int).tolist())
+        mgr.save(step, {"w": w})
+
+    # steps 1..4 then (window [32:40] skipped) two more batches
+    assert consumed == order[:32] + order[40:56]
+    rec = [r for r in incidents() if r["kind"] == "anomaly_rewind"]
+    assert len(rec) == 1
+    assert rec[0]["restored_step"] == 4 and rec[0]["skipped_batches"] == 1
+    # reference trajectory over exactly those batches matches
+    w_ref = np.zeros(4, np.float32)
+    for k in range(6):
+        idx = (order[k * 8:(k + 1) * 8] if k < 4
+               else order[(k + 1) * 8:(k + 2) * 8])
+        err = ds.x[idx] @ w_ref - ds.y[idx]
+        w_ref = w_ref - lr * (2.0 * ds.x[idx].T @ err / 8)
+    np.testing.assert_allclose(w, w_ref, rtol=1e-6)
+    ft.unpin_step(mgr.root)
+
+
+def test_rewind_budget_exhaustion_fails_loudly(tmp_path):
+    clear_incidents()
+    _, _, loader, mgr, guard = _train_setup(tmp_path, max_rewinds=1)
+    mgr.save(1, {"w": np.zeros(4, np.float32)})
+    rw = guard.rewind(3, loss=float("nan"), reason="nonfinite")
+    assert rw.step == 1 and rw.skipped_batches == 2
+    with pytest.raises(RewindBudgetExceeded, match="budget"):
+        guard.check(4, float("inf"))
+    kinds = [r["kind"] for r in incidents()]
+    assert "rewind_budget_exhausted" in kinds
+    ft.unpin_step(mgr.root)
+
+
+def test_rewind_without_checkpoint_fails_loudly(tmp_path):
+    clear_incidents()
+    _, _, _, mgr, guard = _train_setup(tmp_path)
+    with pytest.raises(RewindBudgetExceeded, match="NO"):
+        guard.rewind(2, reason="nonfinite")
+    assert incidents()[-1]["kind"] == "rewind_failed"
+
+
+def test_spike_classification():
+    guard = RewindGuard(None, spike_factor=10.0, min_history=3)
+    for v in (1.0, 1.1, 0.9):
+        assert guard.classify(v) is None
+        guard._history.append(v)
+    assert guard.classify(5.0) is None          # below factor x median
+    assert guard.classify(50.0) == "spike"
+    assert guard.classify(float("nan")) == "nonfinite"
+    assert guard.classify("not-a-loss") is None
+
+
+def test_keep_anchor_pin_survives_prune(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = ft.CheckpointManager(root, backend="pickle", keep=2)
+    for s in range(1, 6):
+        mgr.save(s, {"w": np.full(2, float(s), np.float32)})
+    assert mgr.all_steps() == [4, 5]
+    state, got = mgr.restore(step=4)   # the last-verified-good anchor
+    assert got == 4 and ft.pinned_steps(root) == {4}
+    mgr.save(6, {"w": np.zeros(2, np.float32)})
+    mgr.save(7, {"w": np.zeros(2, np.float32)})
+    # keep=2 would drop 4 and 5; the pinned anchor must survive
+    assert mgr.all_steps() == [4, 6, 7]
+    ft.unpin_step(root)
+    mgr.save(8, {"w": np.zeros(2, np.float32)})
+    assert 4 not in mgr.all_steps()
+
+
+# ---------------------------------------------------------------------------
+# chaos resize= relaunch filter
+# ---------------------------------------------------------------------------
+
+def test_chaos_rule_parses_resize():
+    r = chaos.Rule.parse(
+        "crash@train.step:step=3,rank=0,restart=0,resize=2,exit_code=101")
+    assert (r.action, r.step, r.rank, r.restart, r.resize, r.exit_code) \
+        == ("crash", 3, 0, 0, 2, 101)
+    with pytest.raises(ValueError, match="resize"):
+        chaos.Rule("crash", "p", resize=0)
+
+
+def test_chaos_resize_requires_launcher_rendezvous(monkeypatch):
+    monkeypatch.delenv("PADDLE_MASTER", raising=False)
+    with pytest.raises(RuntimeError, match="PADDLE_MASTER"):
+        chaos._request_resize(2)
+
+
+# ---------------------------------------------------------------------------
+# ckpt_inspect CLI (stdlib-only forensics)
+# ---------------------------------------------------------------------------
+
+def _load_inspect_module():
+    spec = importlib.util.spec_from_file_location("ckpt_inspect", INSPECT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ckpt_inspect_constants_match_fault_tolerance():
+    """The CLI duplicates the protocol constants to stay jax-free; this
+    is the drift guard the duplication comment promises."""
+    mod = _load_inspect_module()
+    assert mod.MANIFEST_NAME == ft.MANIFEST_NAME
+    assert mod.TMP_SUFFIX == ft.TMP_SUFFIX
+    assert mod.OLD_SUFFIX == ft.OLD_SUFFIX
+    assert mod._STEP_RE.pattern == ft._STEP_RE.pattern
+
+
+def _run_inspect(*args):
+    return subprocess.run([sys.executable, INSPECT, *map(str, args)],
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_ckpt_inspect_never_imports_jax(tmp_path):
+    """Forensics must work on a host where jax cannot even import."""
+    code = ("import sys; sys.modules['jax'] = None\n"
+            f"sys.argv = ['ckpt_inspect', {str(tmp_path)!r}]\n"
+            f"exec(open({INSPECT!r}).read())\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2, proc.stderr  # empty dir: uncommitted
+    assert "UNCOMMITTED" in proc.stdout
+
+
+def test_ckpt_inspect_full_manifest(tmp_path):
+    root = str(tmp_path / "ckpt")
+    ds = _ArrayDataset(n=16)
+    smp = DistributedBatchSampler(ds, 4, num_replicas=1, rank=0, seed=0)
+    mgr = ft.CheckpointManager(root, backend="pickle").attach_data(smp)
+    mgr.save(7, {"w": np.arange(6, dtype=np.float32)})
+
+    proc = _run_inspect(root)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    out = proc.stdout
+    assert "COMMITTED" in out and "step: 7" in out
+    assert "topology:" in out and "rng:" in out and "data cursor:" in out
+
+    proc = _run_inspect(root, "--json")
+    rep = json.loads(proc.stdout)
+    assert rep["verdict"] == "committed" and rep["step"] == 7
+    assert rep["data"]["global_batch_size"] == 4
+
+    proc = _run_inspect(root, "--step", 7, "--no-checksums")
+    assert proc.returncode == 0
+
+
+def test_ckpt_inspect_detects_corruption_and_warnings(tmp_path):
+    root = tmp_path / "ckpt"
+    mgr = ft.CheckpointManager(str(root), backend="pickle")
+    mgr.save(1, {"w": np.arange(4, dtype=np.float32)})
+    step_dir = root / ft.step_dir_name(1)
+
+    chaos.corrupt_file(str(step_dir / "state.pdz"), nbytes=4)
+    proc = _run_inspect(step_dir)
+    assert proc.returncode == 2
+    assert "CORRUPT" in proc.stdout and "CRC32" in proc.stdout
+
+    # a bare commit (no topology/rng blocks) verifies but warns: exit 1
+    bare = tmp_path / "bare"
+    tmp = str(bare) + ft.TMP_SUFFIX
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "payload.bin"), "wb") as f:
+        f.write(b"x" * 64)
+    ft.commit_dir(tmp, str(bare), extra={"step": 2})
+    proc = _run_inspect(bare)
+    assert proc.returncode == 1, proc.stdout
+    assert "warning: no topology block" in proc.stdout
+
+    proc = _run_inspect(tmp_path / "missing")
+    assert proc.returncode == 2
+    assert "UNCOMMITTED" in proc.stdout
+
+
+def test_ckpt_inspect_all_steps(tmp_path):
+    root = str(tmp_path / "ckpt")
+    mgr = ft.CheckpointManager(root, backend="pickle", keep=0)
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": np.zeros(2, np.float32)})
+    proc = _run_inspect(root, "--all", "--json")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    reps = json.loads(proc.stdout)
+    assert [r["step"] for r in reps] == [1, 2, 3]

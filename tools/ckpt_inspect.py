@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""ckpt_inspect: checkpoint forensics CLI for paddle_tpu checkpoints.
+
+Prints a committed checkpoint's manifest — step, framework version,
+payload inventory, elastic-resume topology/sharding block, RNG streams,
+data-pipeline cursor — and verifies the commit protocol's checksums,
+all WITHOUT importing jax (or paddle_tpu at all: the commit manifest is
+plain JSON + CRC32s, so this tool is stdlib-only and starts in
+milliseconds, exactly what you want on a wedged pod host).
+
+Usage:
+    python tools/ckpt_inspect.py CKPT_DIR            # one step dir
+    python tools/ckpt_inspect.py ROOT                # newest committed step
+    python tools/ckpt_inspect.py ROOT --step 400
+    python tools/ckpt_inspect.py ROOT --all          # every step, one line each
+    python tools/ckpt_inspect.py CKPT_DIR --json     # machine-readable
+    python tools/ckpt_inspect.py CKPT_DIR --no-checksums   # size-only (fast)
+
+Exit codes (tpu_lint convention): 0 committed and verified, 1 verified
+with warnings (no topology/RNG block, stale tmp/old siblings, version
+unknown), 2 corrupt or uncommitted.
+
+The on-disk format is the fault_tolerance commit protocol: a directory
+is committed iff it carries a ``ptq_manifest.json`` listing every
+payload file's size and CRC32; ``*.ptq-tmp`` siblings are in-flight
+saves, ``*.ptq-old`` are displaced copies mid-swap.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import zlib
+
+# fault_tolerance protocol constants, duplicated so this CLI never
+# imports the framework (asserted equal in tests/test_elastic_reshard.py)
+MANIFEST_NAME = "ptq_manifest.json"
+TMP_SUFFIX = ".ptq-tmp"
+OLD_SUFFIX = ".ptq-old"
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _crc32(path: str, chunk: int = 1 << 20) -> int:
+    c = 0
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            c = zlib.crc32(block, c)
+    return c & 0xFFFFFFFF
+
+
+def read_manifest(dirpath: str):
+    try:
+        with open(os.path.join(dirpath, MANIFEST_NAME)) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) and "files" in man else None
+
+
+def committed_steps(root: str):
+    steps = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return steps
+    for name in names:
+        m = _STEP_RE.match(name)
+        if m and read_manifest(os.path.join(root, name)) is not None:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+def verify(dirpath: str, man: dict, checksums: bool = True):
+    """[] when every manifest entry checks out, else problem strings."""
+    problems = []
+    for ent in man.get("files", []):
+        p = os.path.join(dirpath, ent["path"])
+        if not os.path.isfile(p):
+            problems.append(f"missing payload file {ent['path']!r}")
+            continue
+        size = os.path.getsize(p)
+        if size != ent["bytes"]:
+            problems.append(
+                f"{ent['path']!r}: {size} bytes on disk, manifest says "
+                f"{ent['bytes']} (truncated write?)")
+            continue
+        if checksums and _crc32(p) != ent["crc32"]:
+            problems.append(f"{ent['path']!r}: CRC32 mismatch (bit rot "
+                            f"or torn write)")
+    return problems
+
+
+def inspect_dir(dirpath: str, checksums: bool = True) -> dict:
+    """Everything about one checkpoint dir, as a JSON-able report."""
+    dirpath = os.path.abspath(dirpath)
+    report = {"path": dirpath, "verdict": None, "warnings": [],
+              "problems": []}
+    man = read_manifest(dirpath)
+    if man is None:
+        report["verdict"] = "uncommitted"
+        report["problems"].append(
+            f"no commit manifest ({MANIFEST_NAME}): the save never "
+            f"committed" if os.path.isdir(dirpath)
+            else "directory does not exist")
+        return report
+    report["step"] = man.get("step")
+    report["framework_version"] = man.get("framework_version", "unknown")
+    report["bytes_total"] = man.get("bytes_total")
+    report["n_files"] = len(man.get("files", []))
+    topo = man.get("topology")
+    if isinstance(topo, dict):
+        report["topology"] = topo
+    else:
+        report["warnings"].append(
+            "no topology block (pre-elastic checkpoint: restores only "
+            "onto an identical mesh without reshard.restore_resharded)")
+    shardings = man.get("shardings")
+    if isinstance(shardings, dict):
+        report["n_sharded_params"] = len(shardings)
+        report["shardings"] = {
+            k: {"shape": v.get("shape"), "spec": v.get("spec")}
+            for k, v in sorted(shardings.items())}
+    rng = man.get("rng")
+    if isinstance(rng, dict):
+        report["rng"] = {
+            "rank": rng.get("rank"),
+            "framework": rng.get("framework"),
+            "tracker_streams": sorted(rng.get("tracker") or {}),
+        }
+    else:
+        report["warnings"].append(
+            "no RNG block (dropout/data-aug streams reseed on resume)")
+    data = man.get("data")
+    if isinstance(data, dict):
+        report["data"] = data
+    if report["framework_version"] == "unknown":
+        report["warnings"].append("framework version unknown (RNG "
+                                  "version-skew check cannot run)")
+    for sib in (dirpath + TMP_SUFFIX, dirpath + OLD_SUFFIX):
+        if os.path.exists(sib):
+            report["warnings"].append(
+                f"stale sibling {os.path.basename(sib)!r} (crashed "
+                f"save? recover_dir would clean it)")
+    report["problems"] = verify(dirpath, man, checksums=checksums)
+    report["verdict"] = "corrupt" if report["problems"] else "committed"
+    return report
+
+
+def _fmt_bytes(n) -> str:
+    if not isinstance(n, (int, float)):
+        return "?"
+    size = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if size < 1024 or unit == "TiB":
+            return f"{size:.1f}{unit}" if unit != "B" else f"{int(size)}B"
+        size /= 1024
+    return f"{n}B"
+
+
+def _print_report(rep: dict):
+    print(f"checkpoint: {rep['path']}")
+    print(f"  verdict: {rep['verdict'].upper()}")
+    for p in rep["problems"]:
+        print(f"    problem: {p}")
+    if rep["verdict"] == "uncommitted":
+        return
+    print(f"  step: {rep.get('step')}   framework: "
+          f"{rep.get('framework_version')}   payload: "
+          f"{rep.get('n_files')} files, "
+          f"{_fmt_bytes(rep.get('bytes_total'))}")
+    topo = rep.get("topology")
+    if topo:
+        mesh = topo.get("mesh")
+        mesh_s = "x".join(f"{k}={v}" for k, v in mesh.items()) \
+            if isinstance(mesh, dict) else "?"
+        print(f"  topology: world_size={topo.get('world_size')} "
+              f"rank={topo.get('rank')} mesh[{mesh_s}] "
+              f"devices={topo.get('devices', '?')}")
+    for key, ent in (rep.get("shardings") or {}).items():
+        spec = ent.get("spec")
+        spec_s = ", ".join("+".join(a) if a else "-" for a in (spec or []))
+        print(f"    param {key}: shape={ent.get('shape')} "
+              f"spec=({spec_s})")
+    rng = rep.get("rng")
+    if rng:
+        streams = ",".join(rng.get("tracker_streams") or []) or "-"
+        print(f"  rng: rank={rng.get('rank')} "
+              f"framework={rng.get('framework')} tracker=[{streams}]")
+    data = rep.get("data")
+    if data:
+        print(f"  data cursor: epoch={data.get('epoch')} "
+              f"offset={data.get('offset')} "
+              f"global_batch_size={data.get('global_batch_size')}")
+    for w in rep["warnings"]:
+        print(f"  warning: {w}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt_inspect",
+        description="Inspect and verify a paddle_tpu checkpoint "
+                    "(commit manifest, topology, checksums) without "
+                    "importing jax.")
+    ap.add_argument("path", help="a step_N checkpoint dir, or a root "
+                                 "containing step_* dirs")
+    ap.add_argument("--step", type=int, default=None,
+                    help="pick this step under a root (default: newest)")
+    ap.add_argument("--all", action="store_true",
+                    help="inspect every committed step under a root")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report(s) as JSON on stdout")
+    ap.add_argument("--no-checksums", action="store_true",
+                    help="skip CRC32 verification (sizes only)")
+    args = ap.parse_args(argv)
+
+    path = os.path.abspath(args.path)
+    checksums = not args.no_checksums
+    targets = []
+    if read_manifest(path) is not None or _STEP_RE.match(
+            os.path.basename(path)):
+        targets = [path]
+    else:
+        steps = committed_steps(path)
+        if args.step is not None:
+            targets = [os.path.join(path, f"step_{args.step:08d}")]
+        elif args.all:
+            targets = [os.path.join(path, f"step_{s:08d}") for s in steps]
+        elif steps:
+            targets = [os.path.join(path, f"step_{steps[-1]:08d}")]
+        else:
+            targets = [path]  # report it as uncommitted
+
+    reports = [inspect_dir(t, checksums=checksums) for t in targets]
+    if args.as_json:
+        doc = reports[0] if len(reports) == 1 and not args.all else reports
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        for rep in reports:
+            _print_report(rep)
+    if any(r["verdict"] != "committed" for r in reports):
+        return 2
+    if any(r["warnings"] for r in reports):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
