@@ -1,0 +1,220 @@
+"""Serving benchmark: continuous-batching throughput on one chip.
+
+Drives ``serving.LLMEngine`` with a staggered open-loop workload
+(requests keep arriving while the batch is in flight, so continuous
+admission and the mixed prefill+decode kernel path are both exercised)
+and prints ONE line::
+
+    BENCH_SERVE {"metric": "serve_tokens_per_sec_chip", ...}
+
+with tokens/sec/chip, TTFT p50/p95 and request-latency p50/p95 — the
+Gemma-on-Cloud-TPU serving comparison's headline numbers (PAPERS.md).
+Percentiles come from the ``serve_*`` histograms in the metrics
+registry (enabled for the run).  Real numbers on CPU via the jnp
+reference path; on TPU the Pallas kernel path compiles through the
+persistent XLA cache.
+
+Env knobs (all optional): PADDLE_TPU_BENCH_SERVE_PRESET (default
+llama-debug), _REQUESTS, _PROMPT (max prompt len), _NEW (tokens per
+request), _MAX_RUNNING, _CHUNK, _PAGE, and PADDLE_TPU_BENCH_TIMEOUT
+for the watchdog deadline shared with bench.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_LAST_FILE = os.path.join(_REPO, ".bench_serve_last.json")
+_T0 = time.monotonic()
+
+
+def _log(msg):
+    sys.stderr.write(f"bench_serve[{time.monotonic() - _T0:6.1f}s]: "
+                     f"{msg}\n")
+    sys.stderr.flush()
+
+
+def _env_int(name, default):
+    return int(os.environ.get(f"PADDLE_TPU_BENCH_SERVE_{name}", default))
+
+
+def _percentiles(hist_name, fallback):
+    """p50/p95 (seconds) from a metrics-registry histogram, falling
+    back to numpy over the raw per-request numbers."""
+    import numpy as np
+
+    from paddle_tpu.profiler import metrics
+    v = metrics.snapshot().get(hist_name)
+    if isinstance(v, dict) and v.get("count"):
+        return float(v["p50"]), float(v["p95"])
+    if not fallback:
+        return 0.0, 0.0
+    arr = np.asarray(fallback, dtype=float)
+    return (float(np.percentile(arr, 50)), float(np.percentile(arr, 95)))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.models import llama
+    from paddle_tpu import serving
+
+    _flags.set_flags({"FLAGS_tpu_metrics": True})
+    from paddle_tpu.core import compile_cache
+    try:
+        compile_cache.ensure(force=True)
+    except Exception as e:
+        _log(f"compilation cache unavailable: {e}")
+
+    preset = os.environ.get("PADDLE_TPU_BENCH_SERVE_PRESET",
+                            "llama-debug")
+    n_req = _env_int("REQUESTS", 16)
+    max_prompt = _env_int("PROMPT", 24)
+    n_new = _env_int("NEW", 16)
+    max_running = _env_int("MAX_RUNNING", 8)
+    chunk = _env_int("CHUNK", 8)
+    page = _env_int("PAGE", 16)
+
+    dev = jax.devices()[0]
+    n_chips = jax.device_count()
+    _log(f"backend={dev.platform} preset={preset} requests={n_req} "
+         f"max_running={max_running} chunk={chunk} page={page}")
+
+    cfg = llama.preset(preset)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    max_model_len = min(cfg.max_position_embeddings,
+                        max_prompt + n_new + chunk)
+    eng = serving.LLMEngine(cfg, params, max_running=max_running,
+                            chunk=chunk, page_size=page,
+                            max_model_len=max_model_len)
+
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size,
+                                rng.randint(2, max_prompt + 1)))
+               for _ in range(n_req)]
+
+    # warmup: compile both buckets before the clock starts
+    wid = eng.add_request(prompts[0], 2)
+    while eng.has_work():
+        eng.step()
+    _log(f"warmup done ({len(eng._step_fns)} bucket(s) compiled), "
+         f"warm tokens {eng.output_of(wid)}")
+    # drop the warmup's compile-inflated observations so the reported
+    # percentiles describe steady-state serving only
+    from paddle_tpu.profiler import metrics as _m
+    _m.reset()
+
+    # measured run: half the requests up front, the rest arriving while
+    # the batch is in flight — continuous admission, no drain between
+    t_start = time.monotonic()
+    rids = []
+    for p in prompts[:n_req // 2]:
+        rids.append(eng.add_request(p, n_new))
+    steps = 0
+    pending = list(prompts[n_req // 2:])
+    while eng.has_work() or pending:
+        if pending and steps % 2 == 1:
+            rids.append(eng.add_request(pending.pop(0), n_new))
+        eng.step()
+        steps += 1
+        if steps > 100000:
+            raise RuntimeError("serve loop did not converge")
+    wall_s = time.monotonic() - t_start
+
+    reqs = [eng._requests[r] for r in rids]
+    assert all(len(r.output) == n_new for r in reqs), \
+        "request finished short"
+    tokens = sum(len(r.output) for r in reqs)
+    ttfts = [r.first_token_s - r.arrival_s for r in reqs
+             if r.first_token_s is not None]
+    lats = [r.finish_s - r.arrival_s for r in reqs
+            if r.finish_s is not None]
+    ttft_p50, ttft_p95 = _percentiles("serve_ttft_seconds", ttfts)
+    lat_p50, lat_p95 = _percentiles("serve_request_latency_seconds",
+                                    lats)
+    tps_chip = tokens / wall_s / max(n_chips, 1)
+    stats = serving.serving_stats()
+
+    result = {
+        "metric": "serve_tokens_per_sec_chip",
+        "value": round(tps_chip, 2),
+        "unit": "tokens/s/chip",
+        "ttft_p50_ms": round(ttft_p50 * 1e3, 2),
+        "ttft_p95_ms": round(ttft_p95 * 1e3, 2),
+        "latency_p50_ms": round(lat_p50 * 1e3, 2),
+        "latency_p95_ms": round(lat_p95 * 1e3, 2),
+        "requests": len(rids),
+        "tokens": tokens,
+        "steps": steps,
+        "wall_seconds": round(wall_s, 3),
+        "prefill_tokens": int(stats["prefill_tokens"]),
+        "decode_tokens": int(stats["decode_tokens"]),
+        "preemptions": int(stats["requests_preempted"]),
+        "compiled_buckets": int(stats["compiled_buckets"]),
+        "max_running": max_running,
+        "chunk": chunk,
+        "page_size": page,
+        "preset": preset,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "chips": n_chips,
+    }
+    try:
+        with open(_LAST_FILE, "w") as f:
+            json.dump(result, f)
+    except OSError:
+        pass
+    return result
+
+
+def _error_result(msg, incident=None):
+    out = {
+        "metric": "serve_tokens_per_sec_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "error": msg[-1500:] or "unknown",
+    }
+    if incident is None:
+        try:
+            from paddle_tpu.runtime.watchdog import last_incident
+            incident = last_incident()
+        except Exception:
+            incident = None
+    if incident is not None:
+        out["incident"] = incident
+    try:
+        with open(_LAST_FILE) as f:
+            out["last_measured"] = json.load(f)
+    except Exception:
+        pass
+    return out
+
+
+def run():
+    """Never exit without the BENCH_SERVE line (same contract as
+    bench.py): failures and hangs print value 0.0 with the error and
+    the runtime health layer's incident record attached."""
+    from paddle_tpu.runtime.watchdog import (PhaseTimeout,
+                                             run_with_deadline)
+
+    timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "900"))
+    try:
+        result = run_with_deadline(main, timeout_s, phase="serve_measure")
+    except PhaseTimeout:
+        print("BENCH_SERVE " + json.dumps(_error_result(
+            f"bench_serve timed out after {timeout_s:.0f}s "
+            "(compile or execute hang)")))
+        sys.stdout.flush()
+        os._exit(0)  # the hung measure thread would block a clean exit
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        result = _error_result(str(e) or repr(e))
+    print("BENCH_SERVE " + json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

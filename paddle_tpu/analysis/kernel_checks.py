@@ -117,7 +117,7 @@ class KernelSite:
     """One captured ``pl.pallas_call`` invocation (normalized)."""
 
     def __init__(self, kernel, grid, in_specs, out_specs, out_shapes,
-                 scratch_shapes, file, line):
+                 scratch_shapes, file, line, num_scalar_prefetch=0):
         self.kernel = kernel
         self.grid: Tuple[int, ...] = grid
         self.in_specs = in_specs          # list[BlockSpec | None]
@@ -126,7 +126,11 @@ class KernelSite:
         self.scratch_shapes = scratch_shapes
         self.file = file
         self.line = line
+        # PrefetchScalarGridSpec: the first N operands are SMEM scalar
+        # refs handed to every index_map after the grid indices
+        self.num_scalar_prefetch = int(num_scalar_prefetch)
         self.operands: list = []          # avals, filled at the inner call
+        self.scalar_operands: list = []   # leading scalar-prefetch args
 
     @property
     def kernel_name(self) -> str:
@@ -177,11 +181,16 @@ def _normalize_call(kernel, args, kwargs, blockspec_cls, file, line
     out_specs = kwargs.get("out_specs")
     scratch = kwargs.get("scratch_shapes", ())
     grid_spec = kwargs.get("grid_spec")
+    nsp = 0
     if grid_spec is not None:  # pl.GridSpec / PrefetchScalarGridSpec
         grid = getattr(grid_spec, "grid", grid)
         in_specs = getattr(grid_spec, "in_specs", in_specs)
         out_specs = getattr(grid_spec, "out_specs", out_specs)
         scratch = getattr(grid_spec, "scratch_shapes", scratch)
+        try:
+            nsp = int(getattr(grid_spec, "num_scalar_prefetch", 0) or 0)
+        except (TypeError, ValueError):
+            nsp = 0
     if isinstance(grid, int):
         grid = (grid,)
     try:
@@ -199,7 +208,7 @@ def _normalize_call(kernel, args, kwargs, blockspec_cls, file, line
                    for s in _tree_leaves(out_specs, is_spec)],
         out_shapes=_tree_leaves(out_shape, is_leaf=is_shape),
         scratch_shapes=_tree_leaves(_as_tuple(scratch), is_leaf=is_shape),
-        file=file, line=line)
+        file=file, line=line, num_scalar_prefetch=nsp)
 
 
 @contextlib.contextmanager
@@ -228,8 +237,12 @@ def capture_sites(sites: List[KernelSite]):
 
         @functools.wraps(wrapped)
         def with_operands(*operands, **okw):
-            site.operands = [o for o in operands
-                             if hasattr(o, "shape") and hasattr(o, "dtype")]
+            ops = [o for o in operands
+                   if hasattr(o, "shape") and hasattr(o, "dtype")]
+            # scalar-prefetch operands lead; they live in SMEM and pair
+            # with no BlockSpec, so keep them out of the grid operands
+            site.scalar_operands = ops[:site.num_scalar_prefetch]
+            site.operands = ops[site.num_scalar_prefetch:]
             sites.append(site)
             return wrapped(*operands, **okw)
         return with_operands
@@ -446,12 +459,37 @@ class _SiteChecker:
                     dtype=str(op.dtype))
 
     # --- rules: kernel-index-oob + kernel-output-coverage -----------------
+    def _concrete_scalars(self) -> Optional[tuple]:
+        """Concrete numpy values of the scalar-prefetch operands, or None
+        when any is traced. Registered verify cases close over an example
+        block table (a real ndarray), which makes scalar-driven index
+        maps provable: ``table[r, j]`` works on an ndarray exactly as it
+        does on the SMEM ref. Traced scalars leave the maps unverifiable
+        — skipped and noted, same as any map that raises."""
+        import numpy as np
+        vals = []
+        for o in self.site.scalar_operands:
+            try:
+                vals.append(np.asarray(o))
+            except Exception:  # tracer — no concrete table to prove with
+                return None
+        return tuple(vals)
+
     def _eval_map(self, spec, point) -> Optional[Tuple[int, ...]]:
         index_map = getattr(spec, "index_map", None)
         if index_map is None:
             return (0,) * len(spec.block_shape)
         try:
-            idx = index_map(*point)
+            if self.site.num_scalar_prefetch:
+                scalars = self._scalar_args
+                if scalars is None:
+                    self._index_map_skips.add(
+                        "scalar-prefetch operands are traced — index maps "
+                        "not provable without a concrete example table")
+                    return None
+                idx = index_map(*point, *scalars)
+            else:
+                idx = index_map(*point)
         except Exception as e:  # map needs tracers/refs — skip, note once
             self._index_map_skips.add(f"{type(e).__name__}: {e}")
             return None
@@ -468,6 +506,7 @@ class _SiteChecker:
         if not (want_oob or want_cov) or not self.site.grid:
             return
         self._index_map_skips: set = set()
+        self._scalar_args = self._concrete_scalars()
         points, exhaustive = _grid_points(
             self.site.grid, int(self.cfg["index_eval_points"]))
         for op in blocked:
@@ -619,9 +658,11 @@ class _SiteChecker:
             return
         n_in, n_out = len(s.operands), len(s.out_shapes)
         n_scratch = len(s.scratch_shapes)
-        if len(params) < n_in + n_out:
+        nsp = s.num_scalar_prefetch
+        if len(params) < nsp + n_in + n_out:
             return  # signature does not line up (varargs etc.) — skip
-        roles = ([("in", i) for i in range(n_in)]
+        roles = ([("scalar", i) for i in range(nsp)]
+                 + [("in", i) for i in range(n_in)]
                  + [("out", i) for i in range(n_out)]
                  + [("scratch", i) for i in range(n_scratch)])
         used = {n.id for stmt in fndef.body for n in ast.walk(stmt)
@@ -634,7 +675,8 @@ class _SiteChecker:
         line = getattr(getattr(fn, "__code__", None), "co_firstlineno",
                        None)
         for pname, (role, i) in zip(params, roles):
-            if role == "in" or pname in used or pname.startswith("_"):
+            if role in ("in", "scalar") or pname in used \
+                    or pname.startswith("_"):
                 continue
             self._emit(
                 "kernel-unused-ref",

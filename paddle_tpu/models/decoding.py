@@ -38,13 +38,24 @@ def init_kv_cache(num_layers, batch, max_len, n_kv_heads, head_dim,
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
-def cached_attention_core(q, k_new, v_new, cache_k, cache_v, pos):
+_NEG_BIG = -1e30  # finite mask: -inf would NaN a fully-masked row
+
+
+def cached_attention_core(q, k_new, v_new, cache_k, cache_v, pos,
+                          lengths=None):
     """q/k_new/v_new: [B, T, h, d] for the current chunk starting at
     ``pos`` (traced scalar); cache_k/v: [B, S_max, kv_h, d] for one
     layer. Returns (out [B, T, h, d], new_ck, new_cv).
     GQA: q is viewed as [B, T, kv_h, rep, d] and contracted directly
     against the kv-width cache — the K/V tensors are never expanded to
-    q-head width (the memory that matters at long context)."""
+    q-head width (the memory that matters at long context).
+
+    ``lengths`` (optional, [B] int32): total valid kv length per row
+    including the current chunk; defaults to ``pos + T``.  Cache
+    positions at or past it are masked EXPLICITLY — correctness must
+    not rest on the causal mask happening to cover the unwritten
+    (zero) tail of the cache, and per-row lengths are what a ragged
+    serving batch needs."""
     B, T, nh, d = q.shape
     S_max = cache_k.shape[1]
     nkv = cache_k.shape[2]
@@ -55,14 +66,18 @@ def cached_attention_core(q, k_new, v_new, cache_k, cache_v, pos):
     scale = 1.0 / (d ** 0.5)
     q_pos = pos + jnp.arange(T)
     key_pos = jnp.arange(S_max)
-    mask = key_pos[None, :] <= q_pos[:, None]          # [T, S_max]
+    kv_len = jnp.broadcast_to(
+        jnp.asarray(pos + T if lengths is None else lengths,
+                    jnp.int32), (B,))
+    mask = ((key_pos[None, None, :] <= q_pos[None, :, None])
+            & (key_pos[None, None, :] < kv_len[:, None, None]))
     rep = nh // nkv
     # q head h attends kv head h // rep (the jnp.repeat layout)
     qg = q.reshape(B, T, nkv, rep, d).astype(jnp.float32)
     kf = cache_k.astype(jnp.float32)
     vf = cache_v.astype(jnp.float32)
     logits = jnp.einsum("btkrd,bskd->bkrts", qg, kf) * scale
-    logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+    logits = jnp.where(mask[:, None, None], logits, _NEG_BIG)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkrts,bskd->btkrd", probs, vf)
     return (out.reshape(B, T, nh, d).astype(q.dtype),

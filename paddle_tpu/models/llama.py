@@ -41,7 +41,8 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .decoding import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "init_params", "forward_pure",
-           "build_train_step", "param_specs", "PRESETS", "preset"]
+           "forward_with_cache", "forward_paged", "build_train_step",
+           "param_specs", "PRESETS", "preset"]
 
 
 @dataclasses.dataclass
@@ -467,6 +468,100 @@ def forward_with_cache(cfg: LlamaConfig, params, tokens, cache, pos):
     x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(new_k, new_v)
+
+
+def forward_paged(cfg: LlamaConfig, params, tokens, k_pages, v_pages,
+                  block_tables, seq_lens, q_lens):
+    """Ragged mixed prefill+decode forward over a paged KV cache (the
+    serving engine's step function).
+
+    tokens        [R, Tc] int32   current-chunk token slots; request r
+                                  uses tokens[r, :q_lens[r]]
+    k/v_pages     [L, nkv, P, page, d] per-layer pools
+    block_tables  [R, Bmax] i32   pool page of each logical kv block
+                                  (page 0 = allocator's reserved null
+                                  page, absorbs padding-token scatters)
+    seq_lens      [R] i32         total kv length incl. this chunk
+    q_lens        [R] i32         chunk lengths (0 = inactive slot)
+
+    Fixed shapes throughout — one compilation per (R, Tc, pool)
+    signature.  Rope runs at each token's absolute position
+    (seq_lens - q_lens + t), new k/v are scattered through the block
+    table, and attention is ``ops.pallas_ops.ragged_paged_attention``
+    (jnp reference off-TPU).  Returns (logits [R, Tc, V] fp32,
+    (k_pages, v_pages)); logits in padding rows are garbage by
+    contract — callers read row q_lens[r] - 1."""
+    from ..ops.pallas_ops import ragged_paged_attention
+
+    R, Tc = tokens.shape
+    nh, nkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, \
+        cfg.head_dim
+    H = cfg.hidden_size
+    rep = nh // nkv
+    page = k_pages.shape[3]
+    num_pages = k_pages.shape[2]
+
+    # absolute position of each token slot, clipped for the rope gather
+    start = (seq_lens - q_lens).astype(jnp.int32)        # [R]
+    t_off = jnp.arange(Tc, dtype=jnp.int32)
+    qpos = start[:, None] + t_off[None, :]               # [R, Tc]
+    valid = t_off[None, :] < q_lens[:, None]             # [R, Tc]
+    qpos_c = jnp.clip(qpos, 0, cfg.max_position_embeddings - 1)
+    sin_full, cos_full = _rope_tables(cfg, cfg.max_position_embeddings)
+    sin = jnp.take(sin_full, qpos_c, axis=0)             # [R, Tc, D]
+    cos = jnp.take(cos_full, qpos_c, axis=0)
+
+    def rope(x):
+        # per-token tables (ragged positions), else same as _apply_rope
+        half = x.shape[-1] // 2
+        x1, x2 = x[..., :half], x[..., half:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+        return (x * cos[:, :, None, :].astype(x.dtype)
+                + rot * sin[:, :, None, :].astype(x.dtype))
+
+    # flat pool destination of each new token, through the block table;
+    # padding tokens land on the null page (never mapped, never read)
+    blk = jnp.clip(qpos_c // page, 0, block_tables.shape[1] - 1)
+    phys = jnp.take_along_axis(block_tables, blk, axis=1)  # [R, Tc]
+    dest = jnp.where(valid, phys * page + qpos_c % page, 0).reshape(-1)
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+
+    def body(h, inp):
+        lp, kp, vp = inp
+        xn = _rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        q = rope((xn @ lp["wq"]).reshape(R, Tc, nh, d))
+        k = rope((xn @ lp["wk"]).reshape(R, Tc, nkv, d))
+        v = (xn @ lp["wv"]).reshape(R, Tc, nkv, d)
+        # scatter new k/v: [R, Tc, nkv, d] -> [nkv, R*Tc, d] at dest
+        k_t = k.transpose(2, 0, 1, 3).reshape(nkv, R * Tc, d)
+        v_t = v.transpose(2, 0, 1, 3).reshape(nkv, R * Tc, d)
+        kp = kp.reshape(nkv, num_pages * page, d).at[:, dest].set(
+            k_t.astype(kp.dtype)).reshape(nkv, num_pages, page, d)
+        vp = vp.reshape(nkv, num_pages * page, d).at[:, dest].set(
+            v_t.astype(vp.dtype)).reshape(nkv, num_pages, page, d)
+        # kernel layout [R, nkv, Tc*rep, d]: row t*rep + j = q head
+        # k*rep + j of token t (the h // rep GQA mapping)
+        qk = q.reshape(R, Tc, nkv, rep, d).transpose(
+            0, 2, 1, 3, 4).reshape(R, nkv, Tc * rep, d)
+        out = ragged_paged_attention(qk, kp, vp, block_tables,
+                                     seq_lens, q_lens, rep=rep)
+        out = out.reshape(R, nkv, Tc, rep, d).transpose(
+            0, 2, 1, 3, 4).reshape(R, Tc, H)
+        h = h + out.astype(h.dtype) @ lp["wo"]
+        hn = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        if cfg.moe_num_experts > 0:
+            mlp_out, _aux = _moe_mlp(cfg, lp, hn)
+            h = h + mlp_out
+        else:
+            h = h + _dense_mlp(lp, hn)
+        return h, (kp, vp)
+
+    x, (new_k, new_v) = lax.scan(body, x,
+                                 (params["layers"], k_pages, v_pages))
+    x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, (new_k, new_v)
 
 
 def _cfg_key(cfg):

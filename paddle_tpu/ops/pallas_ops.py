@@ -56,7 +56,9 @@ __all__ = ["causal_attention", "flash_attention_available",
            "fused_attention_available", "fused_mlp_available",
            "fused_attn_block_specs", "fused_mlp_block_specs",
            "fused_attn_candidates", "fused_mlp_candidates",
-           "tune_fused_blocks", "fused_parity_cases"]
+           "tune_fused_blocks", "fused_parity_cases",
+           "ragged_paged_attention", "ragged_attention_available",
+           "rpa_block_specs", "rpa_candidates", "tune_ragged_attention"]
 
 _BQ = 256
 _BK = 256
@@ -1732,6 +1734,394 @@ def fused_parity_cases():
 
 
 # ---------------------------------------------------------------------------
+# Ragged paged attention (the TPU serving kernel)
+# ---------------------------------------------------------------------------
+#
+# One kernel serves a mixed prefill+decode batch over a block-table
+# paged KV cache (PAPERS.md: "Ragged Paged Attention").  Layout:
+#
+#   q            [R, nkv, Tr, d]   Tr = Tc * rep fixed per-request token
+#                                  slots; request r contributes
+#                                  q_lens[r] real tokens (rep q-head
+#                                  slots each), the rest is padding
+#   k/v pools    [nkv, P, page, d] head-major so a (head, page) pair is
+#                                  one contiguous VMEM block
+#   block_tables [R, Bmax] i32     logical kv-block j of request r lives
+#                                  in pool page block_tables[r, j];
+#                                  unused slots hold 0 (page 0 is the
+#                                  allocator's reserved null page)
+#   seq_lens     [R] i32           total kv length incl. current chunk
+#   q_lens       [R] i32           tokens in the current chunk (0 =
+#                                  inactive slot, 1 = decode, >1 =
+#                                  chunked prefill)
+#
+# Grid (R, nkv, Tr//bq_rows, Bmax); the three scalar operands ride in
+# via ``pltpu.PrefetchScalarGridSpec`` so the k/v index maps can read
+# ``tbl[r, j]`` before the block is fetched.  Inner axis j streams kv
+# pages with the online-softmax flash recurrence; pages past the
+# request's causal horizon or its kv length are skipped entirely
+# (``@pl.when``), which is what makes the ragged batch cheap.  Padding
+# rows (tok >= q_lens[r]) are fully masked and flushed as exact zeros.
+
+_NEG_BIG = -1e30  # finite mask value: -inf would NaN fully-masked rows
+
+
+def _rep_cols(col, n):
+    """[R, 1] -> [R, n] broadcast.  Uses the lane-tiling idiom when n is
+    a multiple of the 128-lane width (the only Mosaic-legal case on
+    TPU); any other width is interpret/jnp-only and plain broadcast."""
+    if n % _LANES == 0:
+        return _rep_lanes(col, n)
+    return jnp.broadcast_to(col, (col.shape[0], n))
+
+
+def _rpa_kernel(tbl_ref, lens_ref, qlens_ref, q_ref, k_ref, v_ref, o_ref,
+                m_s, l_s, acc_s, *, page, rep, bq_rows, scale):
+    """Grid point (r, h, qt, j): q rows [qt*bq_rows, +bq_rows) of
+    request r, q-head group h, against kv page j of r's block table."""
+    from jax.experimental import pallas as pl
+    r = pl.program_id(0)
+    qt = pl.program_id(2)
+    j = pl.program_id(3)
+    n_j = pl.num_programs(3)
+    kvlen = lens_ref[r]
+    qlen = qlens_ref[r]
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, _NEG_BIG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # causal horizon of the last row in this q tile: pages strictly past
+    # it contribute nothing to any row and are skipped wholesale
+    last_tok = ((qt + 1) * bq_rows - 1) // rep
+    horizon = kvlen - qlen + last_tok
+
+    @pl.when((j * page < kvlen) & (j * page <= horizon))
+    def _update():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq_rows, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [page, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        row = qt * bq_rows + lax.broadcasted_iota(
+            jnp.int32, (bq_rows, page), 0)
+        tok = row // rep                             # q token index
+        qpos = kvlen - qlen + tok                    # absolute position
+        kpos = j * page + lax.broadcasted_iota(
+            jnp.int32, (bq_rows, page), 1)
+        mask = (kpos <= qpos) & (kpos < kvlen) & (tok < qlen)
+        s = jnp.where(mask, s, _NEG_BIG)
+        m = m_s[...]
+        l = l_s[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1)[:, None])
+        # explicit zeroing: on a fully-masked row exp(s - m) == 1, not 0
+        p = jnp.where(mask,
+                      jnp.exp(s - _rep_cols(m_new[:, :1], page)), 0.0)
+        corr = jnp.exp(m - m_new)
+        l_s[...] = l * corr + jnp.sum(p, axis=-1)[:, None]
+        m_s[...] = m_new
+        d = acc_s.shape[-1]
+        acc_s[...] = (acc_s[...] * _rep_cols(corr[:, :1], d)
+                      + lax.dot(p, v, preferred_element_type=jnp.float32))
+
+    @pl.when(j == n_j - 1)
+    def _flush():
+        d = acc_s.shape[-1]
+        l = l_s[...]
+        denom = jnp.where(l == 0.0, 1.0, l)  # padding rows -> exact 0
+        o_ref[0, 0] = (acc_s[...] / _rep_cols(denom[:, :1], d)).astype(
+            o_ref.dtype)
+
+
+def rpa_block_specs(R, nkv, Tr, d, num_pages, page, Bmax, bq_rows=None):
+    """(block, array) shape pairs for the ragged-paged-attention call —
+    the single source of truth shared by the call site, the candidate
+    generator, and the Level-3 verifier."""
+    if bq_rows is None:
+        bq_rows = Tr
+    qblk = ((1, 1, bq_rows, d), (R, nkv, Tr, d))
+    kvblk = ((1, 1, page, d), (nkv, num_pages, page, d))
+    return {"in": [qblk, kvblk, kvblk], "out": [qblk]}
+
+
+def _ragged_attention_jnp(q, k_pages, v_pages, block_tables, seq_lens,
+                          q_lens, rep):
+    """Reference implementation and CPU fallback: gather every
+    request's pages into a dense [R, Bmax*page] kv span, mask, softmax.
+    Bit-for-bit semantics of the kernel (same ``_NEG_BIG`` masking, f32
+    accumulation, exact-zero padding rows)."""
+    R, nkv, Tr, d = q.shape
+    page = k_pages.shape[2]
+    Bmax = block_tables.shape[1]
+    flat = block_tables.reshape(-1)                  # [R*Bmax]
+    k_seq = jnp.take(k_pages, flat, axis=1).reshape(
+        nkv, R, Bmax * page, d)
+    v_seq = jnp.take(v_pages, flat, axis=1).reshape(
+        nkv, R, Bmax * page, d)
+    scale = 1.0 / math.sqrt(float(d))
+    s = jnp.einsum("rhtd,hrsd->rhts", q.astype(jnp.float32),
+                   k_seq.astype(jnp.float32)) * scale
+    tok = jnp.arange(Tr, dtype=jnp.int32) // rep     # [Tr]
+    qpos = (seq_lens - q_lens)[:, None] + tok[None, :]   # [R, Tr]
+    kpos = jnp.arange(Bmax * page, dtype=jnp.int32)  # [S_all]
+    mask = ((kpos[None, None, :] <= qpos[:, :, None])
+            & (kpos[None, None, :] < seq_lens[:, None, None])
+            & (tok[None, :, None] < q_lens[:, None, None]))
+    s = jnp.where(mask[:, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("rhts,hrsd->rhtd", p, v_seq.astype(jnp.float32))
+    valid = tok[None, :] < q_lens[:, None]           # [R, Tr]
+    return jnp.where(valid[:, None, :, None], o, 0.0).astype(q.dtype)
+
+
+def _rpa_call(q, k_pages, v_pages, block_tables, seq_lens, q_lens, *,
+              rep, bq_rows):
+    """Raw pallas_call for the ragged-paged-attention kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    R, nkv, Tr, d = q.shape
+    num_pages, page = k_pages.shape[1], k_pages.shape[2]
+    Bmax = block_tables.shape[1]
+    n_qt = Tr // bq_rows
+    scale = 1.0 / math.sqrt(float(d))
+    specs = rpa_block_specs(R, nkv, Tr, d, num_pages, page, Bmax,
+                            bq_rows)
+
+    def q_map(r, h, qt, j, tbl, lens, qlens):
+        del j, tbl, lens, qlens
+        return (r, h, qt, 0)
+
+    def kv_map(r, h, qt, j, tbl, lens, qlens):
+        del qt, lens, qlens
+        return (h, tbl[r, j], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(R, nkv, n_qt, Bmax),
+        in_specs=[
+            pl.BlockSpec(specs["in"][0][0], q_map),
+            pl.BlockSpec(specs["in"][1][0], kv_map),
+            pl.BlockSpec(specs["in"][2][0], kv_map),
+        ],
+        out_specs=pl.BlockSpec(specs["out"][0][0], q_map),
+        scratch_shapes=[
+            pltpu.VMEM((bq_rows, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((bq_rows, _LANES), jnp.float32),   # running sum
+            pltpu.VMEM((bq_rows, d), jnp.float32),        # accumulator
+        ],
+    )
+    kern = functools.partial(_rpa_kernel, page=page, rep=rep,
+                             bq_rows=bq_rows, scale=scale)
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, nkv, Tr, d), q.dtype),
+        compiler_params=_compiler_params(
+            "parallel", "parallel", "parallel", "arbitrary"),
+        interpret=_INTERPRET,
+    )(block_tables, seq_lens, q_lens, q, k_pages, v_pages)
+
+
+def ragged_attention_available(q_shape, kv_shape, dtype=None,
+                               bq_rows=None):
+    """True when the Pallas path can serve this problem.  The kernel
+    needs lane-aligned pages (page % 128 == 0) — smaller pages are
+    served by the jnp reference — plus a TPU backend or interpret
+    mode."""
+    del dtype
+    if _DISABLE:
+        return False
+    R, nkv, Tr, d = q_shape
+    page = kv_shape[2]
+    if page % _LANES != 0:
+        return False
+    if bq_rows is not None:
+        if Tr % bq_rows != 0:
+            return False
+        if bq_rows % 8 != 0 and bq_rows != Tr:
+            return False
+    return _on_tpu() or _INTERPRET
+
+
+def _rpa_keys(Tr, d, page, dtype=None):
+    """Lookup-key chain for the tuned bq_rows: context-qualified first,
+    shape-only fallback."""
+    from paddle_tpu.ops import autotune
+    keys = []
+    if dtype is not None:
+        keys.append(["bq_rows", int(Tr), int(d), int(page)]
+                    + autotune.context_key(str(jnp.dtype(dtype))))
+    keys.append(["bq_rows", int(Tr), int(d), int(page)])
+    return keys
+
+
+def _rpa_config(q_shape, kv_shape, dtype=None):
+    """Resolve bq_rows: tuned value if cached and still legal for this
+    shape, else the whole q-slot (one tile per request)."""
+    from paddle_tpu.ops import autotune
+    R, nkv, Tr, d = q_shape
+    page = kv_shape[2]
+    cfg = autotune.lookup_chain("ragged_paged_attention",
+                                _rpa_keys(Tr, d, page, dtype))
+    if cfg is not None:
+        b = int(cfg[0] if isinstance(cfg, (list, tuple)) else cfg)
+        if Tr % b == 0 and (b % 8 == 0 or b == Tr):
+            return b
+    return Tr
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_lens,
+                           q_lens, *, rep=1, bq_rows=None):
+    """Mixed prefill+decode attention over a paged KV cache.
+
+    q            [R, nkv, Tc*rep, d] per-request q slots (GQA: the rep
+                 q heads of kv head h sit at rows tok*rep..tok*rep+rep-1)
+    k/v pages    [nkv, P, page, d] pools
+    block_tables [R, Bmax] i32, seq_lens/q_lens [R] i32 (see module
+                 section comment for the ragged-batch contract)
+
+    Decode is the Tc == 1 specialization of the same kernel.  Falls
+    back to the jnp reference off-TPU, for lane-unaligned pages, or on
+    runtime kernel failure (``_fused_guard``)."""
+
+    def ref():
+        return _ragged_attention_jnp(q, k_pages, v_pages, block_tables,
+                                     seq_lens, q_lens, rep)
+
+    if not ragged_attention_available(q.shape, k_pages.shape, q.dtype,
+                                      bq_rows):
+        return ref()
+    b = bq_rows if bq_rows is not None else _rpa_config(
+        q.shape, k_pages.shape, q.dtype)
+
+    def fused():
+        return _rpa_call(q, k_pages, v_pages, block_tables, seq_lens,
+                         q_lens, rep=rep, bq_rows=b)
+
+    return _fused_guard("ragged_paged_attention", fused, ref)
+
+
+def rpa_candidates(R, nkv, Tr, d, num_pages, page, Bmax,
+                   dtype=jnp.float32):
+    """Legal (bq_rows,) candidates: divisors of Tr that Mosaic can tile
+    (via ``autotune.legal_candidates`` over the real block specs), so
+    illegal shapes are unrepresentable rather than filtered late."""
+    from paddle_tpu.ops import autotune
+    pool = sorted({Tr} | {b for b in (8, 16, 32, 64, 128, 256, 512)
+                          if Tr % b == 0 and b <= Tr})
+    pool = [(b,) for b in pool]
+
+    def spec_fn(cand):
+        (b,) = cand
+        if Tr % b != 0:
+            return None
+        specs = rpa_block_specs(R, nkv, Tr, d, num_pages, page, Bmax, b)
+        return list(specs["in"]) + list(specs["out"])
+
+    bits = 8 * jnp.dtype(dtype).itemsize
+    return autotune.legal_candidates(pool, spec_fn, dtype_bits=bits)
+
+
+def _verify_rpa_candidate(R, nkv, Tr, d, num_pages, page, Bmax, rep,
+                          dtype):
+    """autotune verify hook: refute a (bq_rows,) candidate with the
+    Level-3 verifier before any compile.  Closes over a concrete
+    in-range block table so the scalar-prefetch index maps are
+    provable."""
+    import numpy as np
+    tbl = (np.arange(R * Bmax, dtype=np.int32) % num_pages).reshape(
+        R, Bmax)
+    lens = np.full((R,), min(Bmax * page, page), dtype=np.int32)
+    qlens = np.ones((R,), dtype=np.int32)
+
+    def verify(cand):
+        from paddle_tpu.analysis import kernel_checks as _kc
+        (b,) = cand
+        avals = (
+            jax.ShapeDtypeStruct((R, nkv, Tr, d), dtype),
+            jax.ShapeDtypeStruct((nkv, num_pages, page, d), dtype),
+            jax.ShapeDtypeStruct((nkv, num_pages, page, d), dtype),
+        )
+
+        def fwd(q, kp, vp):
+            return _rpa_call(q, kp, vp, tbl, lens, qlens, rep=rep,
+                             bq_rows=b)
+
+        found = _kc.verify_kernel(
+            fwd, *avals, name=f"ragged_paged_attention[{b}]")
+        return [f"{f.rule}: {f.message}" for f in found
+                if f.severity == "error"]
+    return verify
+
+
+def tune_ragged_attention(R=8, nkv=2, Tc=8, rep=2, d=128, num_pages=64,
+                          page=128, Bmax=8, dtype=jnp.bfloat16,
+                          budget_s=None, verbose=False):
+    """Autotune bq_rows for a serving bucket signature.  Cached result
+    short-circuits; off-TPU (and not interpret) returns None without
+    touching the tuner."""
+    import numpy as np
+    import time
+
+    from paddle_tpu.ops import autotune
+    Tr = Tc * rep
+    cached = autotune.lookup_chain("ragged_paged_attention",
+                                   _rpa_keys(Tr, d, page, dtype))
+    if cached is not None:
+        return tuple(cached) if isinstance(cached, (list, tuple)) \
+            else (int(cached),)
+    if not (_on_tpu() or _INTERPRET):
+        return None
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.standard_normal((R, nkv, Tr, d)), dtype)
+    kp = jnp.asarray(rng.standard_normal((nkv, num_pages, page, d)),
+                     dtype)
+    vp = jnp.asarray(rng.standard_normal((nkv, num_pages, page, d)),
+                     dtype)
+    # page 0 reserved (null page); shuffled assignment like a real
+    # allocator would produce after churn
+    if num_pages - 1 >= R * Bmax:
+        pages = 1 + rng.permutation(num_pages - 1)[:R * Bmax]
+    else:
+        pages = 1 + np.arange(R * Bmax) % (num_pages - 1)
+    tbl = jnp.asarray(pages.reshape(R, Bmax), jnp.int32)
+    lens = jnp.full((R,), Bmax * page, jnp.int32)
+    qlens = jnp.full((R,), Tc, jnp.int32)
+    n_chain = 8
+
+    def time_candidate(cand):
+        (b,) = cand
+
+        @jax.jit
+        def chained(qc):
+            def body(qq, _):
+                o = _rpa_call(qq, kp, vp, tbl, lens, qlens, rep=rep,
+                              bq_rows=b)
+                return qq + o * jnp.asarray(1e-6, qq.dtype), None
+            qf, _ = lax.scan(body, qc, None, length=n_chain)
+            return jnp.sum(qf[0, 0])
+
+        chained(q).block_until_ready()       # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            chained(q).block_until_ready()
+            best = min(best, (time.perf_counter() - t0) / n_chain)
+        return best
+
+    key = _rpa_keys(Tr, d, page, dtype)[0]
+    return autotune.tune(
+        "ragged_paged_attention", key,
+        rpa_candidates(R, nkv, Tr, d, num_pages, page, Bmax, dtype),
+        time_candidate, budget_s=budget_s, verbose=verbose,
+        verify_candidate=_verify_rpa_candidate(
+            R, nkv, Tr, d, num_pages, page, Bmax, rep, dtype))
+
+
+# ---------------------------------------------------------------------------
 # Level-3 kernel-verification registry
 # ---------------------------------------------------------------------------
 
@@ -1821,6 +2211,34 @@ def kernel_verify_cases():
 
         cases.append(("fused_mlp_block", mlp_fwd_bwd,
                       (x, ln, wg, wg, wd, dy)))
+
+    # ragged paged attention: mixed prefill+decode and the decode-only
+    # (Tc == 1) specialization.  The cases close over CONCRETE numpy
+    # block tables / lengths, which is what lets the verifier evaluate
+    # the scalar-prefetch index maps (tbl[r, j]) instead of skipping
+    # them — an out-of-range table entry here would fire index-oob.
+    import numpy as np
+    Rr, nkv, rep, page = 4, 2, 2, _LANES
+    P, Bmax = 16, 4
+    kv_aval = SDS((nkv, P, page, D), f32)
+    tbl = (1 + np.arange(Rr * Bmax, dtype=np.int32)
+           % (P - 1)).reshape(Rr, Bmax)
+    lens = np.full((Rr,), Bmax * page, dtype=np.int32)
+
+    def rpa_case(Tc):
+        Tr = Tc * rep
+        qlens = np.full((Rr,), Tc, dtype=np.int32)
+
+        def fwd(q, kp, vp):
+            return _rpa_call(q, kp, vp, tbl, lens, qlens, rep=rep,
+                             bq_rows=Tr)
+        return fwd, (SDS((Rr, nkv, Tr, D), f32), kv_aval, kv_aval)
+
+    mixed_fn, mixed_avals = rpa_case(8)
+    decode_fn, decode_avals = rpa_case(1)
+    cases.append(("ragged_paged_attention", mixed_fn, mixed_avals))
+    cases.append(("ragged_paged_attention_decode", decode_fn,
+                  decode_avals))
     return cases
 
 

@@ -332,6 +332,9 @@ class Profiler:
         from .. import runtime as _runtime
         lines.extend(_runtime.summary_lines())
         lines.append("-" * len(header))
+        from ..serving import engine as _serving
+        lines.extend(_serving.summary_lines())
+        lines.append("-" * len(header))
         if self._step_times:
             lines.append(self.step_info(time_unit))
         return "\n".join(lines)

@@ -42,7 +42,8 @@ from . import metrics as _metrics
 __all__ = ["enabled", "enable", "disable", "capture_compiled", "analyze",
            "aot_compile", "profiles", "stats", "reset", "max_static_peak",
            "total_generated_code", "summary_lines", "peak_bytes_of",
-           "record_kernel_estimate", "kernel_estimates"]
+           "record_kernel_estimate", "kernel_estimates",
+           "record_reservation", "reservations"]
 
 _FLAG_DICT = _flags._REGISTRY
 _FLAG_NAME = "FLAGS_tpu_xmem"
@@ -250,6 +251,41 @@ def kernel_estimates() -> List[Dict[str, Any]]:
     return vals
 
 
+# ---------------------------------------------------------------------------
+# Long-lived HBM reservations (fed by serving/kv_cache — preallocated
+# pools that memory_analysis() of any single executable cannot see; a
+# capacity plan must add them to the static peaks)
+# ---------------------------------------------------------------------------
+
+_RESERVATIONS: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+
+def record_reservation(name: str, nbytes: int, **detail) -> None:
+    """Record (or update, keyed by name) one long-lived HBM reservation
+    — e.g. the paged-KV pools.  ``nbytes <= 0`` drops the entry (the
+    pool was released)."""
+    with _lock:
+        if nbytes <= 0:
+            _RESERVATIONS.pop(name, None)
+        else:
+            entry = {"name": name, "bytes": int(nbytes)}
+            entry.update(detail)
+            _RESERVATIONS[name] = entry
+    if _metrics.enabled():
+        _metrics.gauge(
+            "xmem_reserved_bytes",
+            "Long-lived HBM reservation (paged-KV pools etc.)",
+            pool=name[:120]).set(max(int(nbytes), 0))
+
+
+def reservations() -> List[Dict[str, Any]]:
+    """Snapshot of live reservations, largest first."""
+    with _lock:
+        vals = [dict(v) for v in _RESERVATIONS.values()]
+    vals.sort(key=lambda e: -e["bytes"])
+    return vals
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if abs(n) < 1024.0 or unit == "TiB":
@@ -265,12 +301,15 @@ def summary_lines(top: int = 8) -> List[str]:
         vals = sorted(_STORE.values(), key=lambda p: -p["peak_bytes"])
         kernels = sorted(_KERNELS.values(),
                          key=lambda e: -e["vmem_bytes"])
+    res_lines = [f"  reserved {r['name'][:34]:<34}"
+                 f"{_fmt_bytes(r['bytes']):>12}"
+                 for r in reservations()]
     lines = ["Memory"]
     if not vals:
         hint = ("  (no executables captured — set FLAGS_tpu_xmem or "
                 "FLAGS_tpu_metrics before compiling)")
         lines.append(hint)
-        return lines + _kernel_lines(kernels, top)
+        return lines + _kernel_lines(kernels, top) + res_lines
     lines.append(f"  executables: {len(vals)}  "
                  f"(static peaks from compiled.memory_analysis)")
     header = (f"  {'Executable':<38}{'PeakHBM':>12}{'Temp':>12}"
@@ -286,7 +325,9 @@ def summary_lines(top: int = 8) -> List[str]:
     if len(vals) > top:
         lines.append(f"  ... {len(vals) - top} more "
                      f"(xmem.profiles() has all)")
-    return lines + _kernel_lines(kernels, top)
+    lines += _kernel_lines(kernels, top)
+    lines += res_lines
+    return lines
 
 
 def _kernel_lines(kernels: List[Dict[str, Any]], top: int) -> List[str]:
@@ -310,3 +351,4 @@ def reset():
     with _lock:
         _STORE.clear()
         _KERNELS.clear()
+        _RESERVATIONS.clear()

@@ -1,0 +1,31 @@
+"""TPU serving engine: continuous batching over a paged KV cache.
+
+The three layers, bottom-up:
+
+  * ``kv_cache``  — page pools, block tables, the HBM capacity plan
+                    (``plan_capacity``: pages-per-chip before a chip
+                    is touched);
+  * ``scheduler`` — continuous (in-flight) batching: chunked prefill,
+                    per-step admission, completion/eviction and
+                    preemption at step boundaries, fixed compiled
+                    shapes;
+  * ``engine``    — ``LLMEngine``: ``add_request()`` / ``step()`` /
+                    streaming ``on_token`` callbacks, one jitted
+                    ``models.llama.forward_paged`` call per step.
+
+The attention primitive underneath is
+``ops.pallas_ops.ragged_paged_attention`` — one Pallas kernel for the
+whole mixed prefill+decode batch, jnp reference off-TPU.  See
+docs/serving.md.
+"""
+from .engine import (LLMEngine, reset_stats, serving_stats,  # noqa: F401
+                     summary_lines)
+from .kv_cache import (BlockAllocator, PagedKVCache,  # noqa: F401
+                       kv_bytes_per_token, plan_capacity)
+from .scheduler import (Request, RequestState,  # noqa: F401
+                        ScheduledSeq, Scheduler, StepPlan)
+
+__all__ = ["LLMEngine", "serving_stats", "reset_stats", "summary_lines",
+           "BlockAllocator", "PagedKVCache", "kv_bytes_per_token",
+           "plan_capacity", "Request", "RequestState", "Scheduler",
+           "StepPlan", "ScheduledSeq"]

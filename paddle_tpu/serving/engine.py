@@ -1,0 +1,284 @@
+"""LLMEngine: the user-facing serving front end.
+
+``add_request()`` enqueues, ``step()`` runs one continuous-batching
+iteration (schedule -> one jitted forward_paged call -> commit), and
+streaming happens through per-request ``on_token`` callbacks.  The
+engine owns the device-side page pools and threads them through the
+compiled step; the scheduler and PagedKVCache own all host-side state.
+
+Compilation discipline: the batch is always [max_running, Tc] with
+Tc in {1, chunk}, so a serving process compiles at most two step
+executables per pool signature regardless of traffic.  Greedy decode
+only — sampling lives in models/decoding.py for the offline path; the
+serving acceptance bar is stream-for-stream parity with
+``forward_with_cache`` greedy decode.
+
+Observability: ``serve_*`` metrics (queue depth, running batch,
+prefill/decode token counters, TTFT and request-latency histograms)
+behind ``FLAGS_tpu_metrics`` — one dict lookup when disabled — plus a
+module-level stats dict that backs the Profiler "Serving" section and
+an xmem reservation for the pool HBM.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..profiler import metrics as _metrics
+from ..profiler import xmem as _xmem
+from .kv_cache import PagedKVCache, _cdiv, kv_bytes_per_token
+from .scheduler import Request, Scheduler
+
+__all__ = ["LLMEngine", "serving_stats", "reset_stats", "summary_lines"]
+
+# process-wide serving stats (Profiler "Serving" section). Plain dict,
+# updated by every engine in the process; cheap enough to keep
+# unconditionally.
+_STATS: Dict[str, float] = {}
+
+
+def _stats_zero() -> Dict[str, float]:
+    return {
+        "engines": 0, "requests_added": 0, "requests_finished": 0,
+        "requests_preempted": 0, "steps": 0, "prefill_tokens": 0,
+        "decode_tokens": 0, "peak_running": 0, "pool_bytes": 0,
+        "compiled_buckets": 0,
+    }
+
+
+_STATS.update(_stats_zero())
+
+
+def serving_stats() -> Dict[str, float]:
+    return dict(_STATS)
+
+
+def reset_stats() -> None:
+    _STATS.clear()
+    _STATS.update(_stats_zero())
+
+
+def summary_lines() -> List[str]:
+    """The "Serving" block of Profiler.summary_table()."""
+    s = _STATS
+    lines = ["Serving"]
+    if not s["engines"]:
+        lines.append("  (no LLMEngine instantiated)")
+        return lines
+    lines.append(
+        f"  requests: {int(s['requests_added'])} added  "
+        f"{int(s['requests_finished'])} finished  "
+        f"{int(s['requests_preempted'])} preempted")
+    lines.append(
+        f"  steps: {int(s['steps'])}  "
+        f"tokens: {int(s['prefill_tokens'])} prefill  "
+        f"{int(s['decode_tokens'])} decode  "
+        f"peak batch: {int(s['peak_running'])}")
+    lines.append(
+        f"  kv pools: {s['pool_bytes'] / 2**20:.1f} MiB  "
+        f"compiled buckets: {int(s['compiled_buckets'])}")
+    return lines
+
+
+class LLMEngine:
+    """Continuous-batching serving engine over ``models/llama.py``.
+
+    Parameters mirror the capacity plan: ``page_size`` tokens per pool
+    page, ``num_pages`` pool pages per layer (default: enough for every
+    slot at ``max_model_len``, +1 for the reserved null page),
+    ``chunk`` the prefill chunk length (also the prefill bucket Tc),
+    ``max_running`` the fixed batch width.
+    """
+
+    def __init__(self, cfg, params, *, max_running: int = 8,
+                 chunk: int = 16, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_model_len: Optional[int] = None,
+                 kv_dtype=None, donate_pools: Optional[bool] = None):
+        from ..models import llama as _llama
+
+        self.cfg = cfg
+        self.params = params
+        self._forward_paged = _llama.forward_paged
+        self.max_running = int(max_running)
+        self.chunk = int(chunk)
+        self.page_size = int(page_size)
+        self.max_model_len = int(
+            min(max_model_len or cfg.max_position_embeddings,
+                cfg.max_position_embeddings))
+        self.max_blocks = _cdiv(self.max_model_len, self.page_size)
+        if num_pages is None:
+            num_pages = self.max_running * self.max_blocks + 1
+        self.num_pages = int(num_pages)
+
+        self.kv = PagedKVCache(self.num_pages, self.page_size,
+                               self.max_blocks)
+        self.scheduler = Scheduler(self.kv, max_running=self.max_running,
+                                   chunk=self.chunk,
+                                   max_model_len=self.max_model_len)
+
+        kv_dtype = kv_dtype or cfg.dtype
+        L, nkv, d = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        shape = (L, nkv, self.num_pages, self.page_size, d)
+        self._kp = jnp.zeros(shape, kv_dtype)
+        self._vp = jnp.zeros(shape, kv_dtype)
+        pool_bytes = 2 * int(np.prod(shape)) * jnp.dtype(kv_dtype).itemsize
+        _xmem.record_reservation(
+            "serving.kv_pages", pool_bytes, pages=self.num_pages,
+            page_size=self.page_size,
+            bytes_per_token=kv_bytes_per_token(
+                cfg, jnp.dtype(kv_dtype).itemsize))
+        self._pool_bytes = pool_bytes
+
+        if donate_pools is None:
+            donate_pools = jax.default_backend() in ("tpu", "axon")
+        self._donate = bool(donate_pools)
+        self._step_fns: Dict[int, Callable] = {}
+        self._requests: Dict[int, Request] = {}
+
+        _STATS["engines"] += 1
+        _STATS["pool_bytes"] += pool_bytes
+
+    # -- request intake --------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int,
+                    eos_token_id: Optional[int] = None,
+                    on_token: Optional[Callable] = None) -> int:
+        """Enqueue one request; returns its id.  ``on_token(rid, token,
+        finished)`` streams every generated token from the step that
+        produced it."""
+        req = Request(prompt=[int(t) for t in prompt],
+                      max_new_tokens=int(max_new_tokens),
+                      eos_token_id=eos_token_id, on_token=on_token,
+                      arrival_s=time.monotonic())
+        self.scheduler.add(req)
+        self._requests[req.rid] = req
+        _STATS["requests_added"] += 1
+        if _metrics.enabled():
+            _metrics.gauge("serve_queue_depth",
+                           "Requests waiting for admission").set(
+                self.scheduler.num_waiting)
+        return req.rid
+
+    def output_of(self, rid: int) -> List[int]:
+        return list(self._requests[rid].output)
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    # -- the compiled step ----------------------------------------------
+    def _step_fn(self, Tc: int):
+        fn = self._step_fns.get(Tc)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._forward_paged
+
+        def step(params, tokens, kp, vp, tbl, lens, qlens):
+            logits, (kp, vp) = fwd(cfg, params, tokens, kp, vp, tbl,
+                                   lens, qlens)
+            last = jnp.clip(qlens - 1, 0, tokens.shape[1] - 1)
+            rows = jnp.take_along_axis(
+                logits, last[:, None, None], axis=1)[:, 0]   # [R, V]
+            return jnp.argmax(rows, axis=-1).astype(jnp.int32), kp, vp
+
+        fn = jax.jit(step, donate_argnums=(2, 3) if self._donate else ())
+        self._step_fns[Tc] = fn
+        _STATS["compiled_buckets"] += 1
+        return fn
+
+    def step(self) -> List[int]:
+        """One continuous-batching iteration.  Returns the request ids
+        that finished at this step boundary (empty list when idle or
+        still mid-flight)."""
+        plan = self.scheduler.schedule()
+        if not plan.seqs:
+            return []
+        R, Tc = self.max_running, plan.bucket
+        Bmax = self.max_blocks
+        tokens = np.zeros((R, Tc), np.int32)
+        tbl = np.zeros((R, Bmax), np.int32)
+        lens = np.zeros((R,), np.int32)
+        qlens = np.zeros((R,), np.int32)
+        prefill = decode = 0
+        for s in plan.seqs:
+            req = s.request
+            tokens[s.slot, :s.q_len] = req.known[req.fed:req.fed + s.q_len]
+            tbl[s.slot] = self.kv.block_row(req.rid)
+            lens[s.slot] = s.seq_len
+            qlens[s.slot] = s.q_len
+            if s.q_len == 1 and s.produces:
+                decode += 1
+            else:
+                prefill += s.q_len
+
+        nxt, self._kp, self._vp = self._step_fn(Tc)(
+            self.params, jnp.asarray(tokens), self._kp, self._vp,
+            jnp.asarray(tbl), jnp.asarray(lens), jnp.asarray(qlens))
+        nxt = np.asarray(nxt)
+
+        now = time.monotonic()
+        finished = self.scheduler.apply(
+            plan, {s.slot: nxt[s.slot] for s in plan.seqs if s.produces},
+            now_s=now)
+
+        _STATS["steps"] += 1
+        _STATS["prefill_tokens"] += prefill
+        _STATS["decode_tokens"] += decode
+        _STATS["requests_preempted"] += len(plan.preempted)
+        _STATS["requests_finished"] += len(finished)
+        _STATS["peak_running"] = max(_STATS["peak_running"],
+                                     len(plan.seqs))
+        if _metrics.enabled():
+            _metrics.gauge("serve_queue_depth",
+                           "Requests waiting for admission").set(
+                self.scheduler.num_waiting)
+            _metrics.gauge("serve_running_batch",
+                           "Requests in the running batch").set(
+                self.scheduler.num_running + len(finished))
+            _metrics.counter("serve_prefill_tokens_total",
+                             "Prompt tokens fed to the model").inc(prefill)
+            _metrics.counter("serve_decode_tokens_total",
+                             "Decode tokens generated").inc(decode)
+            if plan.preempted:
+                _metrics.counter(
+                    "serve_preemptions_total",
+                    "Requests preempted for pool pressure").inc(
+                    len(plan.preempted))
+            for req in plan.seqs:
+                r = req.request
+                if (r.first_token_s is not None
+                        and r.first_token_s == now):
+                    _metrics.histogram(
+                        "serve_ttft_seconds",
+                        "Time to first token").observe(
+                        now - r.arrival_s)
+            for r in finished:
+                _metrics.histogram(
+                    "serve_request_latency_seconds",
+                    "Request arrival to completion").observe(
+                    now - r.arrival_s)
+        return [r.rid for r in finished]
+
+    # -- convenience -----------------------------------------------------
+    def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:
+        """Step until all queued/running work completes (or max_steps);
+        returns rid -> generated tokens for every finished request."""
+        steps = 0
+        while self.has_work():
+            if max_steps is not None and steps >= max_steps:
+                break
+            self.step()
+            steps += 1
+        return {rid: list(r.output) for rid, r in self._requests.items()
+                if not r.state.value == "waiting"}
+
+    def shutdown(self) -> None:
+        """Drop the pools and their xmem reservation."""
+        _STATS["pool_bytes"] -= self._pool_bytes
+        _xmem.record_reservation("serving.kv_pages", 0)
+        self._kp = self._vp = None
+        self._step_fns.clear()

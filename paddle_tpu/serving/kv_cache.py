@@ -1,0 +1,202 @@
+"""Paged KV cache: fixed-size token blocks in preallocated HBM pools.
+
+Reference analog: vLLM's PagedAttention block manager, rebuilt for the
+TPU execution model (PAPERS.md "Ragged Paged Attention").  The pools
+are allocated ONCE per engine — [L, nkv, num_pages, page, d] stacked
+arrays that live for the engine's lifetime and flow through the jitted
+step function as donated carries — and requests own *pages* of them
+via a host-side block table.  Admission control is therefore pure
+bookkeeping: a request fits iff the allocator has enough free pages
+for its worst case, no device allocation ever happens mid-serve.
+
+Page 0 is reserved as the **null page**: the allocator never hands it
+out, every unused block-table slot points at it, and the model's
+scatter of padding-token k/v lands on it.  The ragged kernel masks by
+sequence length, so the null page's contents are never read — but the
+reservation means an out-of-range *table* entry is always a bug the
+Level-3 verifier can catch, never a silently-aliased live page.
+
+HBM accounting goes through ``profiler/xmem.record_reservation`` so
+the capacity math (pool bytes + model weights + executable peaks) is
+available to ``Profiler.summary_table()`` and ``tools/pod_report.py``
+before a chip is touched — ``plan_capacity()`` is that budget as a
+function.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+__all__ = ["BlockAllocator", "PagedKVCache", "kv_bytes_per_token",
+           "plan_capacity"]
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_pages`` pool pages.
+
+    Invariants (asserted by tests/test_serving.py):
+      * page 0 is never allocated (the null page),
+      * a page is owned by at most one request,
+      * capacity == num_pages - 1, and free + allocated == capacity.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: recently-freed pages are reused first, which
+        # keeps the working set of pool pages small
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._owner: Dict[int, object] = {}
+
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._owner)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int, owner=None) -> Optional[List[int]]:
+        """Pop n pages, or None (and no change) if fewer are free."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == 0 or p not in self._owner:
+                raise ValueError(f"freeing page {p} not allocated")
+            del self._owner[p]
+            self._free.append(p)
+
+
+@dataclasses.dataclass
+class _Entry:
+    pages: List[int]           # pool pages, in logical-block order
+    num_tokens: int = 0        # kv tokens written so far
+
+
+class PagedKVCache:
+    """Host-side page bookkeeping for one engine: request id -> block
+    list, plus the [R, Bmax] block-table assembly the kernel consumes.
+    The device pools themselves are owned by the engine (they thread
+    through the jitted step as donated arrays); this class never holds
+    device memory."""
+
+    def __init__(self, num_pages: int, page_size: int, max_blocks: int):
+        self.allocator = BlockAllocator(num_pages, page_size)
+        self.page_size = int(page_size)
+        self.max_blocks = int(max_blocks)    # Bmax of the block table
+        self._table: Dict[object, _Entry] = {}
+
+    # -- allocation ------------------------------------------------------
+    def pages_needed(self, rid, target_tokens: int) -> int:
+        """Extra pages required to grow request rid to target_tokens."""
+        have = len(self._table[rid].pages) if rid in self._table else 0
+        return max(_cdiv(target_tokens, self.page_size) - have, 0)
+
+    def grow(self, rid, target_tokens: int) -> bool:
+        """Ensure rid owns pages covering target_tokens.  All-or-
+        nothing: returns False (state unchanged) when the pool cannot
+        cover it."""
+        need = self.pages_needed(rid, target_tokens)
+        if _cdiv(target_tokens, self.page_size) > self.max_blocks:
+            return False
+        if need:
+            got = self.allocator.alloc(need, owner=rid)
+            if got is None:
+                return False
+            self._table.setdefault(rid, _Entry([])).pages.extend(got)
+        self._table.setdefault(rid, _Entry([]))
+        return True
+
+    def commit(self, rid, num_tokens: int) -> None:
+        """Record that rid's kv is written up to num_tokens."""
+        self._table[rid].num_tokens = num_tokens
+
+    def release(self, rid) -> List[int]:
+        """Free all of rid's pages (completion or preemption)."""
+        entry = self._table.pop(rid, None)
+        if entry is None:
+            return []
+        self.allocator.free(entry.pages)
+        return entry.pages
+
+    def num_tokens(self, rid) -> int:
+        return self._table[rid].num_tokens if rid in self._table else 0
+
+    def block_row(self, rid) -> List[int]:
+        """One block-table row, padded with the null page to Bmax."""
+        pages = self._table[rid].pages if rid in self._table else []
+        return (pages + [0] * self.max_blocks)[:self.max_blocks]
+
+
+# ---------------------------------------------------------------------------
+# capacity planning (hardware-free — pod_report's serving section)
+# ---------------------------------------------------------------------------
+
+def kv_bytes_per_token(cfg, dtype_bytes: int = 2) -> int:
+    """Paged-KV bytes one token costs across all layers (k and v)."""
+    return (2 * cfg.num_hidden_layers * cfg.num_key_value_heads
+            * cfg.head_dim * dtype_bytes)
+
+
+def _param_count(cfg) -> int:
+    """Dense llama parameter count from the config (embed + L blocks +
+    final norm + lm_head), the number that dominates serving HBM."""
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    nh, nkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    per_layer = (H * nh * d + 2 * H * nkv * d + nh * d * H  # attn
+                 + 3 * H * I                                 # gated mlp
+                 + 2 * H)                                    # norms
+    return (cfg.vocab_size * H * 2                           # embed+head
+            + cfg.num_hidden_layers * per_layer + H)
+
+
+def plan_capacity(cfg, *, hbm_bytes: int, page_size: int = 128,
+                  max_model_len: Optional[int] = None,
+                  kv_dtype_bytes: int = 2, weights_dtype_bytes: int = 2,
+                  headroom_fraction: float = 0.10,
+                  runtime_bytes: int = 0) -> dict:
+    """HBM budget for one chip: how many pool pages fit after weights,
+    and how many concurrent max-length requests that sustains.  Pure
+    arithmetic — safe on a CPU-only host, used by pod_report's
+    ``serving`` section and by the engine's default pool sizing."""
+    max_len = int(max_model_len or cfg.max_position_embeddings)
+    weights = _param_count(cfg) * weights_dtype_bytes
+    usable = int(hbm_bytes * (1.0 - headroom_fraction)) - weights \
+        - int(runtime_bytes)
+    page_bytes = kv_bytes_per_token(cfg, kv_dtype_bytes) * page_size
+    num_pages = max(usable // page_bytes, 0)
+    blocks_per_req = _cdiv(max_len, page_size)
+    max_concurrent = (num_pages - 1) // blocks_per_req \
+        if num_pages > 1 else 0
+    return {
+        "hbm_bytes": int(hbm_bytes),
+        "weights_bytes": int(weights),
+        "usable_kv_bytes": max(int(usable), 0),
+        "page_size": int(page_size),
+        "page_bytes": int(page_bytes),
+        "num_pages": int(num_pages),
+        "kv_bytes_per_token": kv_bytes_per_token(cfg, kv_dtype_bytes),
+        "max_model_len": max_len,
+        "blocks_per_request": int(blocks_per_req),
+        "max_concurrent_requests": int(max_concurrent),
+    }

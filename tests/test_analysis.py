@@ -909,15 +909,75 @@ def test_kernel_pragma_suppresses():
     assert _k_rules(found, "kernel-grid-divisibility") == []
 
 
+def _sp_gather_kernel(tbl_ref, x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+# a serving-style block table drives the index maps through scalar
+# prefetch; concrete entries make the maps provable, so a bad entry is
+# a verifier error rather than silent garbage reads on hardware
+_SP_TBL_OOB = np.asarray([0, 1, 9], np.int32)   # page 9 of a 4-page pool
+_SP_TBL_OK = np.asarray([2, 1, 0], np.int32)
+
+
+def _seed_sp_table_oob(x):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(3,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, tbl: (tbl[i], 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, tbl: (i, 0)))
+    return pl.pallas_call(  # LINT-MARK-K-SP-OOB
+        _sp_gather_kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((24, 128), jnp.float32))(
+        _SP_TBL_OOB, x)
+
+
+def test_kernel_scalar_prefetch_table_oob_fires():
+    found = kernel_checks.verify_kernel(
+        _seed_sp_table_oob, jax.ShapeDtypeStruct((32, 128), jnp.float32))
+    hits = _k_rules(found, "kernel-index-oob")
+    assert hits, [f.to_dict() for f in found]
+    f = hits[0]
+    assert f.severity == "error" and f.source == "kernel"
+    assert f.line == _marker_line(_seed_sp_table_oob, "LINT-MARK-K-SP-OOB")
+
+
+def _seed_sp_output_gap(x):
+    # the table is in range, but the OUTPUT map pins every grid step to
+    # the same block — blocks 0 and 1 of the output are never written
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(3,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, tbl: (tbl[i], 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, tbl: (tbl[0], 0)))
+    return pl.pallas_call(  # LINT-MARK-K-SP-GAP
+        _sp_gather_kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((24, 128), jnp.float32))(
+        _SP_TBL_OK, x)
+
+
+def test_kernel_scalar_prefetch_output_gap_fires():
+    found = kernel_checks.verify_kernel(
+        _seed_sp_output_gap, jax.ShapeDtypeStruct((32, 128), jnp.float32))
+    hits = _k_rules(found, "kernel-output-coverage")
+    assert hits, [f.to_dict() for f in found]
+    f = hits[0]
+    assert f.severity == "error"
+    assert f.line == _marker_line(_seed_sp_output_gap, "LINT-MARK-K-SP-GAP")
+    # and the table OOB rule stays quiet: the defect is coverage only
+    assert _k_rules(found, "kernel-index-oob") == []
+
+
 def test_shipped_pallas_kernels_verify_clean():
     """ISSUE acceptance: every kernel in ops/pallas_ops.py verifies
-    clean on CPU — flash fwd/bwd (streamed + resident, f32 + bf16) and
-    the fused decoder-block kernels (fwd + vjp-captured bwd)."""
+    clean on CPU — flash fwd/bwd (streamed + resident, f32 + bf16), the
+    fused decoder-block kernels (fwd + vjp-captured bwd), and the
+    ragged-paged-attention serving kernel (mixed + decode buckets)."""
     cases = kernel_checks.registered_cases()
     names = {c[0] for c in cases}
     assert {"flash_fwd_streamed", "flash_bwd_streamed",
             "flash_fwd_resident", "flash_bwd_resident",
-            "fused_attention_block", "fused_mlp_block"} <= names
+            "fused_attention_block", "fused_mlp_block",
+            "ragged_paged_attention",
+            "ragged_paged_attention_decode"} <= names
     found = kernel_checks.verify_registered()
     assert found == [], [f.to_dict() for f in found]
 

@@ -119,6 +119,83 @@ def test_prompt_overflow_raises():
         llama.generate(cfg, params, ids, 10)
 
 
+def test_cached_attention_explicit_length_mask():
+    """Correctness must not rest on the causal mask happening to cover
+    the unwritten cache tail: with per-row ``lengths`` the output is
+    invariant to arbitrary garbage at or past each row's length."""
+    rng = np.random.RandomState(0)
+    B, T, nh, nkv, d, S = 2, 1, 4, 2, 8, 16
+    q = jnp.asarray(rng.standard_normal((B, T, nh, d)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, T, nkv, d)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, T, nkv, d)), jnp.float32)
+    ck = jnp.asarray(rng.standard_normal((B, S, nkv, d)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((B, S, nkv, d)), jnp.float32)
+    pos = 6
+    lengths = jnp.asarray([7, 4], jnp.int32)  # ragged: row 1 is shorter
+    out, _, _ = decoding.cached_attention_core(q, kn, vn, ck, cv, pos,
+                                               lengths)
+    stale = jnp.asarray(
+        np.arange(S)[None, :] >= np.asarray(lengths)[:, None])
+    ck2 = jnp.where(stale[:, :, None, None], 1e4, ck)
+    cv2 = jnp.where(stale[:, :, None, None], -1e4, cv)
+    out2, _, _ = decoding.cached_attention_core(q, kn, vn, ck2, cv2, pos,
+                                                lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               atol=1e-6)
+    # row 1's explicit horizon (4) is tighter than causal pos+T (7):
+    # poisoning INSIDE the causal window but past the length is inert
+    mid = jnp.asarray(np.arange(S)[None, :] == 5)
+    ck3 = jnp.where(mid[:, :, None, None], 1e4, ck)
+    out3, _, _ = decoding.cached_attention_core(q, kn, vn, ck3, cv, pos,
+                                                lengths)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(out3[1]),
+                               atol=1e-6)
+
+
+def test_paged_forward_matches_dense_cache():
+    """The serving path (paged pools + ragged kernel reference) is
+    logit-identical to forward_with_cache for prefill, decode, and
+    chunked prefill."""
+    cfg = _tiny_llama()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    L, nkv, d = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    page, n_pages, bmax, R = 8, 16, 8, 2
+    rng = np.random.RandomState(1)
+    prompt = jnp.asarray(rng.randint(0, 128, (1, 7)), jnp.int32)
+    P = prompt.shape[1]
+
+    cache = decoding.init_kv_cache(L, 1, 32, nkv, d, dtype=jnp.float32)
+    dlogits, cache = llama.forward_with_cache(cfg, params, prompt,
+                                              cache, 0)
+
+    # paged: the request lives in slot 0 on shuffled pages; slot 1 idle
+    kp = jnp.zeros((L, nkv, n_pages, page, d), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    tbl = np.zeros((R, bmax), np.int32)
+    tbl[0, :4] = [3, 1, 7, 5]
+    tbl = jnp.asarray(tbl)
+    tokens = jnp.zeros((R, P), jnp.int32).at[0].set(prompt[0])
+    plogits, (kp, vp) = llama.forward_paged(
+        cfg, params, tokens, kp, vp, tbl,
+        jnp.asarray([P, 0], jnp.int32), jnp.asarray([P, 0], jnp.int32))
+    np.testing.assert_allclose(np.asarray(plogits[0, :P]),
+                               np.asarray(dlogits[0]), atol=1e-3)
+
+    # one decode step on top of the same pools
+    nxt = jnp.argmax(dlogits[0, -1]).astype(jnp.int32)
+    dlogits2, cache = llama.forward_with_cache(
+        cfg, params, nxt[None, None], cache, P)
+    tok2 = jnp.zeros((R, 1), jnp.int32).at[0, 0].set(nxt)
+    plogits2, (kp, vp) = llama.forward_paged(
+        cfg, params, tok2, kp, vp, tbl,
+        jnp.asarray([P + 1, 0], jnp.int32), jnp.asarray([1, 0], jnp.int32))
+    np.testing.assert_allclose(np.asarray(plogits2[0, 0]),
+                               np.asarray(dlogits2[0, 0]), atol=1e-3)
+    assert int(jnp.argmax(plogits2[0, 0])) == int(jnp.argmax(
+        dlogits2[0, 0]))
+
+
 def test_layer_facade_generate():
     from paddle_tpu.models.gpt import GPTForCausalLM
     net = GPTForCausalLM(_tiny_gpt())

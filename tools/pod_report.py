@@ -61,6 +61,9 @@ def _parse_args(argv=None):
     ap.add_argument("--global-batch", type=int, default=16)
     ap.add_argument("--seq", type=int, default=None,
                     help="sequence length (default: preset max positions)")
+    ap.add_argument("--page-size", type=int, default=128,
+                    help="paged-KV tokens per pool page for the "
+                         "serving capacity section")
     ap.add_argument("--topology", default=None,
                     help="override the planner: dp,pp,sharding,mp")
     ap.add_argument("--out", default="-",
@@ -291,6 +294,7 @@ def build_report(args):
         },
         "collectives": _collectives_of(compiled),
         "kernels": _kernel_section(gen),
+        "serving": _serving_section(cfg, gen, args),
         "predicted": {
             "step_time_ms": round(pred_step_us / 1e3, 3),
             "mfu": round(mfu, 4),
@@ -340,6 +344,27 @@ def _kernel_section(gen):
         "findings": [f.to_dict() for f in findings],
         "ok": not any(f.severity == "error" for f in findings),
     }
+
+
+def _serving_section(cfg, gen, args):
+    """Paged-KV serving capacity on one chip of this generation —
+    hardware-free arithmetic (serving.plan_capacity): how many pool
+    pages fit beside the bf16 weights and how many concurrent
+    max-length requests per chip that sustains.  The number an
+    operator needs before sizing a serving fleet."""
+    try:
+        from paddle_tpu.serving import plan_capacity
+    except ImportError:
+        return None
+    hbm = int(gen["hbm_gib"] * 2**30)
+    seq = args.seq or cfg.max_position_embeddings
+    plan = plan_capacity(cfg, hbm_bytes=hbm,
+                         page_size=int(args.page_size),
+                         max_model_len=seq)
+    plan["weights_gib"] = round(plan["weights_bytes"] / 2**30, 2)
+    plan["usable_kv_gib"] = round(plan["usable_kv_bytes"] / 2**30, 2)
+    plan["fits"] = plan["max_concurrent_requests"] > 0
+    return plan
 
 
 def _plan_notes(n_dev):
