@@ -16,8 +16,16 @@ persistent XLA cache.
 
 Env knobs (all optional): PADDLE_TPU_BENCH_SERVE_PRESET (default
 llama-debug), _REQUESTS, _PROMPT (max prompt len), _NEW (tokens per
-request), _MAX_RUNNING, _CHUNK, _PAGE, and PADDLE_TPU_BENCH_TIMEOUT
-for the watchdog deadline shared with bench.py.
+request), _MAX_RUNNING, _CHUNK, _PAGE, _PAGES (pool pages — shrink to
+force pool pressure), _MAX_QUEUE (admission bound — overload runs shed
+past it), _TTFT_SLO_MS / _LAT_SLO_MS (SLO targets checked in the
+resilience block), and PADDLE_TPU_BENCH_TIMEOUT for the watchdog
+deadline shared with bench.py.
+
+The JSON line carries a ``resilience`` block (shed / recoveries /
+quarantined / deadline-expired counts for the measured run, plus the
+observed-vs-target SLO verdicts) so overload and chaos E2E runs are
+assertable from the one-line contract.
 """
 from __future__ import annotations
 
@@ -79,6 +87,10 @@ def main():
     max_running = _env_int("MAX_RUNNING", 8)
     chunk = _env_int("CHUNK", 8)
     page = _env_int("PAGE", 16)
+    max_queue = _env_int("MAX_QUEUE", 8 * max_running)
+    pages_env = os.environ.get("PADDLE_TPU_BENCH_SERVE_PAGES")
+    ttft_slo = os.environ.get("PADDLE_TPU_BENCH_SERVE_TTFT_SLO_MS")
+    lat_slo = os.environ.get("PADDLE_TPU_BENCH_SERVE_LAT_SLO_MS")
 
     dev = jax.devices()[0]
     n_chips = jax.device_count()
@@ -89,9 +101,15 @@ def main():
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     max_model_len = min(cfg.max_position_embeddings,
                         max_prompt + n_new + chunk)
+    slo = serving.SLOConfig(
+        ttft_p95_s=float(ttft_slo) / 1e3 if ttft_slo else None,
+        latency_p95_s=float(lat_slo) / 1e3 if lat_slo else None)
     eng = serving.LLMEngine(cfg, params, max_running=max_running,
                             chunk=chunk, page_size=page,
-                            max_model_len=max_model_len)
+                            num_pages=int(pages_env) if pages_env
+                            else None,
+                            max_model_len=max_model_len,
+                            max_queue=max_queue, slo=slo)
 
     rng = np.random.RandomState(0)
     prompts = [list(rng.randint(0, cfg.vocab_size,
@@ -108,37 +126,76 @@ def main():
     # percentiles describe steady-state serving only
     from paddle_tpu.profiler import metrics as _m
     _m.reset()
+    eng._ttft_s.clear()
+    eng._latency_s.clear()
+    # the module stats dict is cumulative across the process — the
+    # resilience block reports measured-run deltas from this snapshot
+    base = serving.serving_stats()
 
     # measured run: half the requests up front, the rest arriving while
-    # the batch is in flight — continuous admission, no drain between
+    # the batch is in flight — continuous admission, no drain between.
+    # Overload runs (_MAX_QUEUE below the offered load) shed here with
+    # the typed retriable AdmissionRejected — counted, never fatal.
     t_start = time.monotonic()
     rids = []
+    shed_submits = 0
+
+    def _submit(p):
+        nonlocal shed_submits
+        try:
+            rids.append(eng.add_request(p, n_new))
+        except serving.AdmissionRejected:
+            shed_submits += 1
+
     for p in prompts[:n_req // 2]:
-        rids.append(eng.add_request(p, n_new))
+        _submit(p)
     steps = 0
     pending = list(prompts[n_req // 2:])
     while eng.has_work() or pending:
         if pending and steps % 2 == 1:
-            rids.append(eng.add_request(pending.pop(0), n_new))
+            _submit(pending.pop(0))
         eng.step()
         steps += 1
         if steps > 100000:
             raise RuntimeError("serve loop did not converge")
     wall_s = time.monotonic() - t_start
 
+    stats_now = serving.serving_stats()
+    res = {k: int(stats_now[k] - base[k])
+           for k in ("shed", "admission_waits", "recoveries",
+                     "quarantined", "deadline_expired",
+                     "callback_errors")}
     reqs = [eng._requests[r] for r in rids]
-    assert all(len(r.output) == n_new for r in reqs), \
+    done = [r for r in reqs if r.state.value == "finished"]
+    assert all(len(r.output) == n_new for r in done), \
         "request finished short"
-    tokens = sum(len(r.output) for r in reqs)
-    ttfts = [r.first_token_s - r.arrival_s for r in reqs
+    if not (res["quarantined"] or res["deadline_expired"]):
+        # without a terminal resilience event every admitted request
+        # must complete — shedding only ever rejects at the front door
+        assert len(done) == len(reqs), "admitted request lost"
+    tokens = sum(len(r.output) for r in done)
+    ttfts = [r.first_token_s - r.arrival_s for r in done
              if r.first_token_s is not None]
-    lats = [r.finish_s - r.arrival_s for r in reqs
+    lats = [r.finish_s - r.arrival_s for r in done
             if r.finish_s is not None]
     ttft_p50, ttft_p95 = _percentiles("serve_ttft_seconds", ttfts)
     lat_p50, lat_p95 = _percentiles("serve_request_latency_seconds",
                                     lats)
     tps_chip = tokens / wall_s / max(n_chips, 1)
-    stats = serving.serving_stats()
+    stats = stats_now
+
+    def _ms(v):
+        return None if v is None else round(v * 1e3, 2)
+
+    rep = eng.slo_report()
+    res["slo"] = {
+        "ttft_p95_ms": _ms(rep["ttft_p95_s"]),
+        "ttft_slo_ms": _ms(rep["ttft_slo_s"]),
+        "ttft_ok": rep["ttft_ok"],
+        "latency_p95_ms": _ms(rep["latency_p95_s"]),
+        "latency_slo_ms": _ms(rep["latency_slo_s"]),
+        "latency_ok": rep["latency_ok"],
+    }
 
     result = {
         "metric": "serve_tokens_per_sec_chip",
@@ -149,6 +206,9 @@ def main():
         "latency_p50_ms": round(lat_p50 * 1e3, 2),
         "latency_p95_ms": round(lat_p95 * 1e3, 2),
         "requests": len(rids),
+        "shed_submits": shed_submits,
+        "max_queue": max_queue,
+        "resilience": res,
         "tokens": tokens,
         "steps": steps,
         "wall_seconds": round(wall_s, 3),

@@ -115,6 +115,12 @@ define_flag("FLAGS_tpu_watchdog_collective", 120.0,
 define_flag("FLAGS_tpu_watchdog_ckpt_commit", 300.0,
             "Deadline (s) for the ckpt.commit watchdog phase (the "
             "atomic checkpoint rename + fsync protocol). <=0 disables.")
+define_flag("FLAGS_tpu_watchdog_serve_step", 120.0,
+            "Deadline (s) for one serving engine step (serve.step "
+            "watchdog phase): schedule + compiled forward + commit. A "
+            "step past the deadline is treated as a hung device call "
+            "and converted into the engine's pool-rebuild replay "
+            "recovery. <=0 disables.")
 define_flag("FLAGS_tpu_xmem", False,
             "Capture per-executable memory_analysis()/cost_analysis() "
             "(HBM peaks, temp bytes, flops) at every jit/Executor/"

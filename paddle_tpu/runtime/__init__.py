@@ -14,14 +14,16 @@ from .watchdog import (PhaseTimeout, Watchdog, run_with_deadline,  # noqa: F401
                        init_with_retries, incidents, last_incident,
                        record_incident, clear_incidents)
 from .health import (CollectiveTimeout, HealthMonitor,  # noqa: F401
-                     collective_beacon, record_fused_fallback)
+                     HeartbeatTracker, collective_beacon,
+                     record_fused_fallback)
 from .rewind import (RewindBudgetExceeded, RewindResult,  # noqa: F401
                      RewindGuard)
 
 __all__ = ["watchdog", "health", "rewind", "PhaseTimeout", "Watchdog",
            "run_with_deadline", "init_with_retries", "incidents",
            "last_incident", "record_incident", "clear_incidents",
-           "CollectiveTimeout", "HealthMonitor", "collective_beacon",
+           "CollectiveTimeout", "HealthMonitor", "HeartbeatTracker",
+           "collective_beacon",
            "record_fused_fallback", "RewindBudgetExceeded", "RewindResult",
            "RewindGuard", "summary_lines"]
 

@@ -45,9 +45,9 @@ from typing import Any, Callable, Dict, List, Optional, Set
 
 from .watchdog import PhaseTimeout, record_incident, _dump_all_threads
 
-__all__ = ["CollectiveTimeout", "HealthMonitor", "install", "uninstall",
-           "get", "monitored", "current_step", "set_step",
-           "collective_beacon", "record_fused_fallback"]
+__all__ = ["CollectiveTimeout", "HealthMonitor", "HeartbeatTracker",
+           "install", "uninstall", "get", "monitored", "current_step",
+           "set_step", "collective_beacon", "record_fused_fallback"]
 
 RELAUNCH_EXIT_CODE = 101  # distributed.fault_tolerance contract (PR 5)
 
@@ -62,6 +62,53 @@ class CollectiveTimeout(PhaseTimeout):
         self.rank = rank
         super().__init__("collective", elapsed_s, deadline_s,
                          detail=f"{op} on rank {rank}")
+
+
+class HeartbeatTracker:
+    """Observer-clock heartbeat staleness: a peer is declared dead when
+    its published counter stops CHANGING for ``timeout_s`` seconds on
+    the *observer's* clock — no cross-host clock agreement needed.
+
+    This is the failure-detection rule :class:`HealthMonitor` applies to
+    peer ranks, factored out so other observers can reuse it: the
+    serving :class:`~paddle_tpu.serving.router.Router` tracks engine
+    replica liveness with the same machinery (ROADMAP 1(b)). The clock
+    is injectable so staleness is unit-testable without sleeping.
+    """
+
+    def __init__(self, timeout_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        # name -> [last counter value, local time it last changed]
+        self._seen: Dict[Any, List[float]] = {}
+
+    def observe(self, name, counter) -> float:
+        """Record the latest counter for ``name``; returns how long (s)
+        the counter has been unchanged (0.0 when it just advanced)."""
+        now = self._clock()
+        seen = self._seen.get(name)
+        if seen is None or seen[0] != counter:
+            self._seen[name] = [counter, now]
+            return 0.0
+        return now - seen[1]
+
+    def silent_for(self, name) -> float:
+        """Seconds since ``name``'s counter last changed (0.0 if never
+        observed)."""
+        seen = self._seen.get(name)
+        return 0.0 if seen is None else self._clock() - seen[1]
+
+    def is_stale(self, name) -> bool:
+        seen = self._seen.get(name)
+        return (seen is not None
+                and self._clock() - seen[1] > self.timeout_s)
+
+    def stale(self) -> List:
+        return [n for n in self._seen if self.is_stale(n)]
+
+    def forget(self, name) -> None:
+        self._seen.pop(name, None)
 
 
 class HealthMonitor:
@@ -108,8 +155,9 @@ class HealthMonitor:
         # monitor thread; replaced atomically, never mutated
         self._coll: Optional[Dict[str, Any]] = None
         self._coll_seq = 0
-        # rank -> [last_counter, local time the counter last changed]
-        self._seen: Dict[int, List[float]] = {}
+        # peer staleness: the shared observer-clock timeout detector
+        self._tracker = HeartbeatTracker(self.heartbeat_timeout,
+                                         clock=clock)
         self.dead: Set[int] = set()
         self.stragglers: Set[int] = set()
         self.failed: Optional[str] = None  # reason, once converted
@@ -216,19 +264,17 @@ class HealthMonitor:
                 payload = pickle.loads(raw)
             except Exception:
                 continue
-            seen = self._seen.get(peer)
-            if seen is None or seen[0] != payload["n"]:
-                self._seen[peer] = [payload["n"], now]
-            elif (now - seen[1] > self.heartbeat_timeout
+            silent = self._tracker.observe(peer, payload["n"])
+            if (silent > self.heartbeat_timeout
                     and peer not in self.dead):
                 self.dead.add(peer)
                 found.append(record_incident(
                     "rank_dead", peer=peer, step=payload.get("step"),
-                    silent_s=round(now - seen[1], 3),
+                    silent_s=round(silent, 3),
                     timeout_s=self.heartbeat_timeout))
                 self._metric("health_rank_dead_total", peer=str(peer))
                 self._convert(f"rank {peer} heartbeat silent "
-                              f"{now - seen[1]:.1f}s "
+                              f"{silent:.1f}s "
                               f"(> {self.heartbeat_timeout:.1f}s)")
                 return found
             if payload.get("step") is not None:

@@ -58,6 +58,7 @@ PHASES = {
     "first_step": "FLAGS_tpu_watchdog_first_step",
     "collective": "FLAGS_tpu_watchdog_collective",
     "ckpt.commit": "FLAGS_tpu_watchdog_ckpt_commit",
+    "serve.step": "FLAGS_tpu_watchdog_serve_step",
 }
 
 

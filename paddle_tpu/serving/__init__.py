@@ -11,21 +11,42 @@ The three layers, bottom-up:
                     shapes;
   * ``engine``    — ``LLMEngine``: ``add_request()`` / ``step()`` /
                     streaming ``on_token`` callbacks, one jitted
-                    ``models.llama.forward_paged`` call per step.
+                    ``models.llama.forward_paged`` call per step, plus
+                    the resilience layer: bounded admission with typed
+                    retriable shedding, per-request deadlines/SLOs,
+                    cooperative cancellation, and step-failure
+                    recovery (pool rebuild + replay, poison-request
+                    bisection quarantine);
+  * ``router``    — ``Router``: the multi-replica front door — load-
+                    and cache-locality-aware placement, heartbeat
+                    liveness, drain-on-SIGTERM, and dead-replica
+                    failover with idempotent bit-identical replay;
+  * ``errors``    — the typed failure taxonomy callers branch on
+                    (``retriable`` or terminal).
 
 The attention primitive underneath is
 ``ops.pallas_ops.ragged_paged_attention`` — one Pallas kernel for the
 whole mixed prefill+decode batch, jnp reference off-TPU.  See
-docs/serving.md.
+docs/serving.md and docs/robustness.md ("Serving resilience").
 """
-from .engine import (LLMEngine, reset_stats, serving_stats,  # noqa: F401
-                     summary_lines)
+from .engine import (LLMEngine, SLOConfig, reset_stats,  # noqa: F401
+                     serving_stats, summary_lines)
+from .errors import (AdmissionRejected, DeadlineExceeded,  # noqa: F401
+                     ReplicaUnavailable, RequestQuarantined,
+                     RetriableError, ServingError)
 from .kv_cache import (BlockAllocator, PagedKVCache,  # noqa: F401
                        kv_bytes_per_token, plan_capacity)
+from .router import (EngineReplica, ReplicaState, Router,  # noqa: F401
+                     RouterRequest)
 from .scheduler import (Request, RequestState,  # noqa: F401
                         ScheduledSeq, Scheduler, StepPlan)
 
-__all__ = ["LLMEngine", "serving_stats", "reset_stats", "summary_lines",
+__all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
+           "summary_lines",
            "BlockAllocator", "PagedKVCache", "kv_bytes_per_token",
            "plan_capacity", "Request", "RequestState", "Scheduler",
-           "StepPlan", "ScheduledSeq"]
+           "StepPlan", "ScheduledSeq",
+           "Router", "RouterRequest", "ReplicaState", "EngineReplica",
+           "ServingError", "RetriableError", "AdmissionRejected",
+           "DeadlineExceeded", "RequestQuarantined",
+           "ReplicaUnavailable"]

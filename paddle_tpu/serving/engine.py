@@ -13,14 +13,41 @@ only — sampling lives in models/decoding.py for the offline path; the
 serving acceptance bar is stream-for-stream parity with
 ``forward_with_cache`` greedy decode.
 
+Resilience (the fault story):
+
+  * **Admission control** — a bounded queue with watermark hysteresis:
+    at ``max_queue`` waiting requests admission sheds with the typed,
+    retriable :class:`~paddle_tpu.serving.errors.AdmissionRejected`
+    and stays shedding until the queue drains below half.  Bounded
+    host memory under any open-loop load.
+  * **Deadlines/SLOs** — per-request absolute deadlines on the
+    engine's injectable monotonic clock; expiry at a step boundary is
+    a terminal FAILED with
+    :class:`~paddle_tpu.serving.errors.DeadlineExceeded`.  TTFT and
+    request-latency samples back ``slo_report()``.
+  * **Crash recovery** — ``step()`` runs under the ``serve.step``
+    watchdog phase and a same-named chaos point.  Any step failure
+    (device error, injected fault, hung call past the deadline,
+    non-finite logits via the PR-3 numerics checks) is classified,
+    the *suspect donated pools are discarded* and rebuilt from
+    host-side scheduler state, and every in-flight request replays
+    its full history through the unified fed/known path — greedy
+    decode makes the replay bit-identical.  A poison-pill request is
+    found by bisecting the failed batch on scratch pools and
+    quarantined (:class:`~paddle_tpu.serving.errors
+    .RequestQuarantined`) so the other streams survive it.
+
 Observability: ``serve_*`` metrics (queue depth, running batch,
-prefill/decode token counters, TTFT and request-latency histograms)
-behind ``FLAGS_tpu_metrics`` — one dict lookup when disabled — plus a
-module-level stats dict that backs the Profiler "Serving" section and
-an xmem reservation for the pool HBM.
+prefill/decode token counters, TTFT and request-latency histograms,
+shed/recovery/quarantine counters) behind ``FLAGS_tpu_metrics`` — one
+dict lookup when disabled — plus a module-level stats dict that backs
+the Profiler "Serving" section and an xmem reservation for the pool
+HBM.
 """
 from __future__ import annotations
 
+import dataclasses
+import logging
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -29,11 +56,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler import metrics as _metrics
+from ..profiler import numerics as _numerics
 from ..profiler import xmem as _xmem
+from ..runtime.watchdog import (PhaseTimeout, Watchdog, global_watchdog,
+                                record_incident)
+from ..testing.chaos import ChaosError, ReplicaKilled, chaos_point
+from .errors import (AdmissionRejected, DeadlineExceeded,
+                     RequestQuarantined)
 from .kv_cache import PagedKVCache, _cdiv, kv_bytes_per_token
-from .scheduler import Request, Scheduler
+from .scheduler import Request, RequestState, Scheduler, StepPlan
 
-__all__ = ["LLMEngine", "serving_stats", "reset_stats", "summary_lines"]
+__all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
+           "summary_lines"]
+
+_LOG = logging.getLogger("paddle_tpu.serving")
 
 # process-wide serving stats (Profiler "Serving" section). Plain dict,
 # updated by every engine in the process; cheap enough to keep
@@ -47,6 +83,10 @@ def _stats_zero() -> Dict[str, float]:
         "requests_preempted": 0, "steps": 0, "prefill_tokens": 0,
         "decode_tokens": 0, "peak_running": 0, "pool_bytes": 0,
         "compiled_buckets": 0,
+        # resilience counters (this module + serving/router.py)
+        "shed": 0, "admission_waits": 0, "callback_errors": 0,
+        "recoveries": 0, "quarantined": 0, "deadline_expired": 0,
+        "cancelled": 0, "failovers": 0, "replicas_dead": 0, "drains": 0,
     }
 
 
@@ -81,7 +121,57 @@ def summary_lines() -> List[str]:
     lines.append(
         f"  kv pools: {s['pool_bytes'] / 2**20:.1f} MiB  "
         f"compiled buckets: {int(s['compiled_buckets'])}")
+    lines.append(
+        f"  resilience: {int(s['recoveries'])} recoveries  "
+        f"{int(s['quarantined'])} quarantined  "
+        f"{int(s['shed'])} shed  "
+        f"{int(s['deadline_expired'])} deadline-expired  "
+        f"{int(s['cancelled'])} cancelled")
+    lines.append(
+        f"  replicas: {int(s['failovers'])} failovers  "
+        f"{int(s['replicas_dead'])} dead  "
+        f"{int(s['drains'])} drains  "
+        f"callback errors: {int(s['callback_errors'])}")
     return lines
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Service-level objectives for one engine (or router).  All in
+    seconds; None leaves that objective unset.  ``deadline_s`` is the
+    default per-request deadline applied at admission when the caller
+    passes none."""
+
+    ttft_p95_s: Optional[float] = None
+    latency_p95_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+
+
+class _SafeCallback:
+    """Isolates a raising user ``on_token`` callback from the step
+    loop: the first exception is logged once and counted in
+    ``serve_callback_errors_total``, the callback is disarmed, and the
+    request's stream (decode, kv pages, completion) stays alive."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+        self._dead = False
+
+    def __call__(self, rid, token, finished):
+        if self._dead:
+            return
+        try:
+            self._fn(rid, token, finished)
+        except Exception as exc:  # noqa: BLE001 — isolation is the point
+            self._dead = True
+            _STATS["callback_errors"] += 1
+            _LOG.warning(
+                "on_token callback for request %s raised %r; disarming "
+                "the callback, stream continues", rid, exc)
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serve_callback_errors_total",
+                    "User on_token callbacks that raised").inc()
 
 
 class LLMEngine:
@@ -92,13 +182,24 @@ class LLMEngine:
     slot at ``max_model_len``, +1 for the reserved null page),
     ``chunk`` the prefill chunk length (also the prefill bucket Tc),
     ``max_running`` the fixed batch width.
+
+    Resilience knobs: ``clock`` is the engine's monotonic time source
+    (injectable for tests; never wall time, so NTP steps cannot corrupt
+    latency histograms), ``max_queue`` bounds the admission queue
+    (default ``8 * max_running``), ``slo`` carries TTFT/latency targets
+    and the default per-request deadline, ``watchdog`` overrides the
+    flag-gated global watchdog for the ``serve.step`` phase.
     """
 
     def __init__(self, cfg, params, *, max_running: int = 8,
                  chunk: int = 16, page_size: int = 16,
                  num_pages: Optional[int] = None,
                  max_model_len: Optional[int] = None,
-                 kv_dtype=None, donate_pools: Optional[bool] = None):
+                 kv_dtype=None, donate_pools: Optional[bool] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_queue: Optional[int] = None,
+                 slo: Optional[SLOConfig] = None,
+                 watchdog: Optional[Watchdog] = None):
         from ..models import llama as _llama
 
         self.cfg = cfg
@@ -115,6 +216,15 @@ class LLMEngine:
             num_pages = self.max_running * self.max_blocks + 1
         self.num_pages = int(num_pages)
 
+        self._clock = clock
+        self.max_queue = int(max_queue if max_queue is not None
+                             else 8 * self.max_running)
+        self.slo = slo
+        self._watchdog = watchdog
+        self._shedding = False
+        self._ttft_s: List[float] = []
+        self._latency_s: List[float] = []
+
         self.kv = PagedKVCache(self.num_pages, self.page_size,
                                self.max_blocks)
         self.scheduler = Scheduler(self.kv, max_running=self.max_running,
@@ -124,10 +234,12 @@ class LLMEngine:
         kv_dtype = kv_dtype or cfg.dtype
         L, nkv, d = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                      cfg.head_dim)
-        shape = (L, nkv, self.num_pages, self.page_size, d)
-        self._kp = jnp.zeros(shape, kv_dtype)
-        self._vp = jnp.zeros(shape, kv_dtype)
-        pool_bytes = 2 * int(np.prod(shape)) * jnp.dtype(kv_dtype).itemsize
+        self._kv_dtype = kv_dtype
+        self._pool_shape = (L, nkv, self.num_pages, self.page_size, d)
+        self._kp = jnp.zeros(self._pool_shape, kv_dtype)
+        self._vp = jnp.zeros(self._pool_shape, kv_dtype)
+        pool_bytes = (2 * int(np.prod(self._pool_shape))
+                      * jnp.dtype(kv_dtype).itemsize)
         _xmem.record_reservation(
             "serving.kv_pages", pool_bytes, pages=self.num_pages,
             page_size=self.page_size,
@@ -140,6 +252,7 @@ class LLMEngine:
         self._donate = bool(donate_pools)
         self._step_fns: Dict[int, Callable] = {}
         self._requests: Dict[int, Request] = {}
+        self._steps = 0
 
         _STATS["engines"] += 1
         _STATS["pool_bytes"] += pool_bytes
@@ -147,14 +260,42 @@ class LLMEngine:
     # -- request intake --------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int,
                     eos_token_id: Optional[int] = None,
-                    on_token: Optional[Callable] = None) -> int:
+                    on_token: Optional[Callable] = None,
+                    deadline_s: Optional[float] = None) -> int:
         """Enqueue one request; returns its id.  ``on_token(rid, token,
         finished)`` streams every generated token from the step that
-        produced it."""
+        produced it (isolated — a raising callback cannot kill the
+        engine).  ``deadline_s`` is relative to now on the engine
+        clock; default comes from ``slo.deadline_s``.
+
+        Raises :class:`AdmissionRejected` (retriable) when the bounded
+        queue is shedding."""
+        depth = self.scheduler.num_waiting
+        if self._shedding and depth <= self.max_queue // 2:
+            self._shedding = False
+        if not self._shedding and depth >= self.max_queue:
+            self._shedding = True
+        if self._shedding:
+            _STATS["shed"] += 1
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serve_shed_total",
+                    "Requests rejected by admission control").inc()
+            raise AdmissionRejected(
+                f"admission queue at {depth}/{self.max_queue}; "
+                f"shedding until it drains below {self.max_queue // 2} "
+                f"— retry with backoff")
+        if deadline_s is None and self.slo is not None:
+            deadline_s = self.slo.deadline_s
+        now = self._clock()
         req = Request(prompt=[int(t) for t in prompt],
                       max_new_tokens=int(max_new_tokens),
-                      eos_token_id=eos_token_id, on_token=on_token,
-                      arrival_s=time.monotonic())
+                      eos_token_id=eos_token_id,
+                      on_token=(_SafeCallback(on_token)
+                                if on_token is not None else None),
+                      arrival_s=now,
+                      deadline_s=(None if deadline_s is None
+                                  else now + float(deadline_s)))
         self.scheduler.add(req)
         self._requests[req.rid] = req
         _STATS["requests_added"] += 1
@@ -167,8 +308,32 @@ class LLMEngine:
     def output_of(self, rid: int) -> List[int]:
         return list(self._requests[rid].output)
 
+    def state_of(self, rid: int) -> RequestState:
+        return self._requests[rid].state
+
+    def error_of(self, rid: int) -> Optional[BaseException]:
+        """Terminal error for a FAILED request (DeadlineExceeded,
+        RequestQuarantined), else None."""
+        return self._requests[rid].error
+
     def has_work(self) -> bool:
         return self.scheduler.has_work()
+
+    def cancel(self, rid: int) -> bool:
+        """Cooperative cancellation: takes effect immediately at the
+        host level (pages freed, slot opened, queue entry dropped).
+        Returns False when the request is already terminal."""
+        req = self._requests.get(rid)
+        if req is None or req.state not in (RequestState.WAITING,
+                                            RequestState.RUNNING):
+            return False
+        self.scheduler.remove(req, now_s=self._clock(),
+                              state=RequestState.CANCELLED)
+        _STATS["cancelled"] += 1
+        if _metrics.enabled():
+            _metrics.counter("serve_cancelled_total",
+                             "Requests cancelled by the caller").inc()
+        return True
 
     # -- the compiled step ----------------------------------------------
     def _step_fn(self, Tc: int):
@@ -183,47 +348,100 @@ class LLMEngine:
             last = jnp.clip(qlens - 1, 0, tokens.shape[1] - 1)
             rows = jnp.take_along_axis(
                 logits, last[:, None, None], axis=1)[:, 0]   # [R, V]
-            return jnp.argmax(rows, axis=-1).astype(jnp.int32), kp, vp
+            # chk: one float per row (max logit) — a cheap [R] transfer
+            # the numerics watchdog scans for NaN/Inf poisoning
+            return (jnp.argmax(rows, axis=-1).astype(jnp.int32),
+                    jnp.max(rows, axis=-1), kp, vp)
 
         fn = jax.jit(step, donate_argnums=(2, 3) if self._donate else ())
         self._step_fns[Tc] = fn
         _STATS["compiled_buckets"] += 1
         return fn
 
-    def step(self) -> List[int]:
-        """One continuous-batching iteration.  Returns the request ids
-        that finished at this step boundary (empty list when idle or
-        still mid-flight)."""
-        plan = self.scheduler.schedule()
-        if not plan.seqs:
-            return []
-        R, Tc = self.max_running, plan.bucket
-        Bmax = self.max_blocks
+    @staticmethod
+    def _batch_arrays(seqs, R: int, Tc: int, Bmax: int, kv):
+        """Host-side input assembly for one step over ``seqs``."""
         tokens = np.zeros((R, Tc), np.int32)
         tbl = np.zeros((R, Bmax), np.int32)
         lens = np.zeros((R,), np.int32)
         qlens = np.zeros((R,), np.int32)
-        prefill = decode = 0
-        for s in plan.seqs:
+        for s in seqs:
             req = s.request
             tokens[s.slot, :s.q_len] = req.known[req.fed:req.fed + s.q_len]
-            tbl[s.slot] = self.kv.block_row(req.rid)
+            tbl[s.slot] = kv.block_row(req.rid)
             lens[s.slot] = s.seq_len
             qlens[s.slot] = s.q_len
+        return tokens, tbl, lens, qlens
+
+    def _wd(self) -> Optional[Watchdog]:
+        if self._watchdog is not None:
+            return self._watchdog
+        from ..core.flags import flag
+        if flag("FLAGS_tpu_watchdog"):
+            return global_watchdog()
+        return None
+
+    def _expire_deadlines(self, now: float) -> None:
+        active = [r for r in self.scheduler.slots if r is not None]
+        active.extend(self.scheduler.waiting)
+        for req in active:
+            if req.deadline_s is None or now <= req.deadline_s:
+                continue
+            self.scheduler.remove(
+                req, now_s=now, state=RequestState.FAILED,
+                error=DeadlineExceeded(
+                    f"request {req.rid} missed its deadline by "
+                    f"{now - req.deadline_s:.3f}s "
+                    f"({len(req.output)} tokens streamed)"))
+            _STATS["deadline_expired"] += 1
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serve_deadline_expired_total",
+                    "Requests failed at their deadline").inc()
+
+    def step(self) -> List[int]:
+        """One continuous-batching iteration.  Returns the request ids
+        that finished at this step boundary (empty list when idle,
+        still mid-flight, or after a recovered step failure)."""
+        now = self._clock()
+        self._expire_deadlines(now)
+        plan = self.scheduler.schedule()
+        if plan.admission_blocked:
+            # the pool (not the slot array) is the bottleneck: the
+            # head-of-line request stays queued, never dropped
+            _STATS["admission_waits"] += 1
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serve_admission_wait_total",
+                    "Steps where free slots waited on pool pages").inc(
+                    )
+        if not plan.seqs:
+            return []
+        R, Tc = self.max_running, plan.bucket
+        tokens, tbl, lens, qlens = self._batch_arrays(
+            plan.seqs, R, Tc, self.max_blocks, self.kv)
+        prefill = decode = 0
+        for s in plan.seqs:
             if s.q_len == 1 and s.produces:
                 decode += 1
             else:
                 prefill += s.q_len
 
-        nxt, self._kp, self._vp = self._step_fn(Tc)(
-            self.params, jnp.asarray(tokens), self._kp, self._vp,
-            jnp.asarray(tbl), jnp.asarray(lens), jnp.asarray(qlens))
-        nxt = np.asarray(nxt)
+        try:
+            nxt = self._guarded_forward(plan, tokens, tbl, lens, qlens,
+                                        Tc)
+        except ReplicaKilled:
+            # whole-replica death is the router's failure domain, not a
+            # step-recoverable fault — propagate
+            raise
+        except Exception as exc:  # noqa: BLE001 — classified in _recover
+            return self._recover(plan, exc)
 
-        now = time.monotonic()
+        now = self._clock()
         finished = self.scheduler.apply(
             plan, {s.slot: nxt[s.slot] for s in plan.seqs if s.produces},
             now_s=now)
+        self._steps += 1
 
         _STATS["steps"] += 1
         _STATS["prefill_tokens"] += prefill
@@ -232,6 +450,12 @@ class LLMEngine:
         _STATS["requests_finished"] += len(finished)
         _STATS["peak_running"] = max(_STATS["peak_running"],
                                      len(plan.seqs))
+        for s in plan.seqs:
+            r = s.request
+            if r.first_token_s is not None and r.first_token_s == now:
+                self._ttft_s.append(now - r.arrival_s)
+        for r in finished:
+            self._latency_s.append(now - r.arrival_s)
         if _metrics.enabled():
             _metrics.gauge("serve_queue_depth",
                            "Requests waiting for admission").set(
@@ -248,8 +472,8 @@ class LLMEngine:
                     "serve_preemptions_total",
                     "Requests preempted for pool pressure").inc(
                     len(plan.preempted))
-            for req in plan.seqs:
-                r = req.request
+            for s in plan.seqs:
+                r = s.request
                 if (r.first_token_s is not None
                         and r.first_token_s == now):
                     _metrics.histogram(
@@ -263,10 +487,190 @@ class LLMEngine:
                     now - r.arrival_s)
         return [r.rid for r in finished]
 
+    def _guarded_forward(self, plan: StepPlan, tokens, tbl, lens, qlens,
+                         Tc: int) -> np.ndarray:
+        """The device call under the serve.step watchdog phase, chaos
+        point, and numerics check.  Returns the sampled tokens [R]."""
+        wd = self._wd()
+        if wd is not None:
+            wd.begin("serve.step")
+        try:
+            chaos_point("serve.step", step=self._steps,
+                        rids=[s.request.rid for s in plan.seqs],
+                        pool=self.kv.allocator, engine=self)
+            nxt, chk, self._kp, self._vp = self._step_fn(Tc)(
+                self.params, jnp.asarray(tokens), self._kp, self._vp,
+                jnp.asarray(tbl), jnp.asarray(lens), jnp.asarray(qlens))
+            nxt = np.asarray(nxt)
+            if _numerics.enabled():
+                rows = np.asarray(chk)[[s.slot for s in plan.seqs]]
+                _numerics.check_array(rows, "serve.step.logits",
+                                      action="raise")
+            if wd is not None:
+                # synchronous expiry: a device call that *eventually*
+                # returned past its deadline is still a hang — convert
+                # it to PhaseTimeout here (poll records dump/metric/
+                # incident), same recovery as a ticker-detected hang
+                for exc in wd.poll(raise_on_expire=False):
+                    if exc.phase == "serve.step":
+                        raise exc
+            return nxt
+        finally:
+            if wd is not None:
+                wd.end("serve.step")
+
+    # -- crash recovery --------------------------------------------------
+    @staticmethod
+    def _classify(exc: BaseException) -> str:
+        if isinstance(exc, PhaseTimeout):
+            return "hang"
+        if isinstance(exc, _numerics.NonFiniteError):
+            return "non_finite"
+        if isinstance(exc, ChaosError):
+            return "injected"
+        if isinstance(exc, (RuntimeError, OSError)):
+            return "device_error"
+        return "unknown"
+
+    def _rebuild(self) -> List[Request]:
+        """Discard the (suspect, possibly donated-away) device pools
+        and all host page state; rebuild both from scratch and demote
+        every running request to the front of the queue with fed=0 —
+        the unified fed/known path then replays prompt + generated
+        tokens, bit-identical under greedy decode."""
+        self.kv = PagedKVCache(self.num_pages, self.page_size,
+                               self.max_blocks)
+        self.scheduler.kv = self.kv
+        self._kp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        self._vp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        demoted = self.scheduler.reset_running()
+        self.scheduler.requeue_front(demoted)
+        return demoted
+
+    def _probe(self, group: List[Request]) -> bool:
+        """Replay ``group``'s first chunks on scratch pools; True when
+        the step is clean.  Fires the serve.step chaos point with the
+        group's rids, so a ``rid=``-scoped rule keeps blaming its
+        target and bisection converges on it deterministically."""
+        kv = PagedKVCache(self.num_pages, self.page_size,
+                          self.max_blocks)
+        seqs = []
+        for slot, req in enumerate(group):
+            q = min(self.chunk, req.num_known)
+            kv.grow(req.rid, q)
+            seqs.append(_ProbeSeq(req, slot, q))
+        Tc = self.chunk if any(s.q_len > 1 for s in seqs) else 1
+        tokens, tbl, lens, qlens = self._batch_arrays(
+            seqs, self.max_running, Tc, self.max_blocks, kv)
+        try:
+            chaos_point("serve.step", step=self._steps,
+                        rids=[r.rid for r in group],
+                        pool=kv.allocator, engine=self, probe=True)
+            _, chk, _, _ = self._step_fn(Tc)(
+                self.params, jnp.asarray(tokens),
+                jnp.zeros(self._pool_shape, self._kv_dtype),
+                jnp.zeros(self._pool_shape, self._kv_dtype),
+                jnp.asarray(tbl), jnp.asarray(lens),
+                jnp.asarray(qlens))
+            if _numerics.enabled():
+                rows = np.asarray(chk)[[s.slot for s in seqs]]
+                _numerics.check_array(rows, "serve.step.probe",
+                                      action="raise")
+            return True
+        except Exception:  # noqa: BLE001 — a dirty probe IS the signal
+            return False
+
+    def _bisect(self, suspects: List[Request]) -> Optional[Request]:
+        """Binary-search the failed batch for a single poison request
+        on scratch pools (at most ``1 + 2*ceil(log2 R)`` probes).
+        None means the failure did not reproduce in isolation —
+        transient, everyone replays."""
+        group = list(suspects)
+        if not group or self._probe(group):
+            return None
+        while len(group) > 1:
+            mid = len(group) // 2
+            if not self._probe(group[:mid]):
+                group = group[:mid]
+            elif not self._probe(group[mid:]):
+                group = group[mid:]
+            else:
+                return None  # only fails in combination — transient
+        return group[0]
+
+    def _recover(self, plan: StepPlan, exc: Exception) -> List[int]:
+        """A failed/hung/poisoned step: classify, rebuild the pools
+        from host-side state, quarantine a bisected culprit, replay the
+        rest.  Always returns [] — no request finishes at a failed
+        step boundary."""
+        failure = self._classify(exc)
+        suspects = [s.request for s in plan.seqs]
+        self._rebuild()
+        culprit = None
+        if failure != "hang":
+            # probing a genuinely hung fault would hang recovery too;
+            # hangs replay wholesale instead
+            culprit = self._bisect(suspects)
+        if culprit is not None:
+            self.scheduler.remove(
+                culprit, now_s=self._clock(),
+                state=RequestState.FAILED,
+                error=RequestQuarantined(
+                    f"request {culprit.rid} quarantined: bisection "
+                    f"blamed it for a {failure} step failure ({exc})"))
+            _STATS["quarantined"] += 1
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serve_quarantined_total",
+                    "Requests quarantined by step-failure "
+                    "bisection").inc()
+        _STATS["recoveries"] += 1
+        record_incident(
+            "serve_step_failure", failure=failure, step=int(self._steps),
+            batch=len(suspects),
+            culprit=(None if culprit is None else int(culprit.rid)),
+            replayed=len(suspects) - (culprit is not None),
+            error=str(exc)[:200])
+        if _metrics.enabled():
+            _metrics.counter(
+                "serve_recoveries_total",
+                "Engine step failures recovered via pool-rebuild "
+                "replay", failure=failure).inc()
+        _LOG.warning(
+            "serve.step failure (%s) at step %d: rebuilt pools, "
+            "replaying %d request(s)%s", failure, self._steps,
+            len(suspects) - (culprit is not None),
+            "" if culprit is None
+            else f", quarantined request {culprit.rid}")
+        return []
+
+    # -- SLO reporting ----------------------------------------------------
+    def slo_report(self) -> Dict[str, Optional[float]]:
+        """Observed TTFT/latency p95 against the configured SLOs; the
+        ``*_ok`` entries are None when no target is set."""
+
+        def _p95(xs):
+            return float(np.percentile(xs, 95)) if xs else None
+
+        ttft, lat = _p95(self._ttft_s), _p95(self._latency_s)
+        slo = self.slo or SLOConfig()
+        rep: Dict[str, Optional[float]] = {
+            "ttft_p95_s": ttft, "latency_p95_s": lat,
+            "ttft_slo_s": slo.ttft_p95_s,
+            "latency_slo_s": slo.latency_p95_s,
+            "ttft_ok": None, "latency_ok": None,
+        }
+        if slo.ttft_p95_s is not None and ttft is not None:
+            rep["ttft_ok"] = ttft <= slo.ttft_p95_s
+        if slo.latency_p95_s is not None and lat is not None:
+            rep["latency_ok"] = lat <= slo.latency_p95_s
+        return rep
+
     # -- convenience -----------------------------------------------------
     def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:
         """Step until all queued/running work completes (or max_steps);
-        returns rid -> generated tokens for every finished request."""
+        returns rid -> generated tokens for every request that left the
+        WAITING state (including cancelled/failed partials)."""
         steps = 0
         while self.has_work():
             if max_steps is not None and steps >= max_steps:
@@ -282,3 +686,21 @@ class LLMEngine:
         _xmem.record_reservation("serving.kv_pages", 0)
         self._kp = self._vp = None
         self._step_fns.clear()
+
+
+@dataclasses.dataclass
+class _ProbeSeq:
+    """Minimal ScheduledSeq stand-in for ``_batch_arrays`` during
+    bisection probes (fed is always 0 — probes replay first chunks)."""
+
+    request: Request
+    slot: int
+    q_len: int
+
+    @property
+    def seq_len(self) -> int:
+        return self.q_len
+
+    @property
+    def produces(self) -> bool:
+        return self.q_len == self.request.num_known

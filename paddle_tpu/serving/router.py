@@ -1,0 +1,412 @@
+"""Multi-replica serving front door.
+
+Reference analog: the fleet front ends in the vLLM/SGLang lineage and
+the Gemma-on-Cloud-TPU serving story (PAPERS.md): "millions of users"
+means N engine replicas behind one router, on capacity that can be
+preempted at any time.  ROADMAP item 1(b).
+
+The router owns the request's *identity* (gid, prompt, delivered
+tokens, deadline); each engine owns only the replica-local decode
+state.  That split is what makes every resilience path below a replay:
+
+  * **Placement** — live, non-draining replicas ranked by queue load
+    with a cache-locality bonus when the prompt's prefix was recently
+    placed on the replica (shared system prompts land together, the
+    prefix-cache groundwork).  A replica that sheds
+    (:class:`AdmissionRejected`) is skipped; if every live replica
+    sheds, the rejection propagates to the caller — typed, retriable.
+  * **Liveness** — every replica heartbeats by making step progress;
+    :class:`~paddle_tpu.runtime.health.HeartbeatTracker` (the same
+    observer-clock rule the cross-rank HealthMonitor uses) declares a
+    replica dead when its beat counter stalls past the timeout, and a
+    step that raises (or blows ``step_timeout_s``) kills the replica
+    immediately.
+  * **Failover** — a dead replica's requests are resubmitted to the
+    survivors as ``prompt + delivered_tokens`` with the remaining
+    token budget: greedy decode makes the continuation bit-identical
+    to the uninterrupted stream, and because the router resumes from
+    what was already *delivered*, replay is idempotent — no token is
+    streamed twice.
+  * **Drain** — SIGTERM (or an explicit ``drain()``) stops placement
+    on the replica and migrates its queued + in-flight requests to
+    the survivors, the preemption-notice path.
+
+Engine-terminal failures (quarantine, deadline expiry) are *not*
+retried — resubmitting a poison request would just poison the next
+replica; the typed error is surfaced on the router request instead.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import logging
+import signal
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..profiler import metrics as _metrics
+from ..runtime.health import HeartbeatTracker
+from ..runtime.watchdog import record_incident, run_with_deadline
+from ..testing.chaos import chaos_point
+from . import engine as _engine
+from .errors import (AdmissionRejected, DeadlineExceeded,
+                     ReplicaUnavailable)
+from .scheduler import RequestState
+
+__all__ = ["Router", "RouterRequest", "ReplicaState", "EngineReplica"]
+
+_LOG = logging.getLogger("paddle_tpu.serving")
+_GIDS = itertools.count()
+
+# replicas remember this many recent prompt prefixes for locality
+_PREFIX_LRU = 64
+
+
+class ReplicaState(enum.Enum):
+    LIVE = "live"
+    DRAINING = "draining"   # finishes nothing new; requests migrated
+    DEAD = "dead"
+
+
+@dataclasses.dataclass
+class EngineReplica:
+    name: str
+    engine: object                      # LLMEngine
+    state: ReplicaState = ReplicaState.LIVE
+    beats: int = 0                      # liveness counter (step progress)
+    prefixes: OrderedDict = dataclasses.field(
+        default_factory=OrderedDict)    # prefix key -> None (LRU)
+
+
+@dataclasses.dataclass
+class RouterRequest:
+    """One stream as the caller sees it, replica placement aside."""
+
+    gid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    on_token: Optional[Callable]        # (gid, token, finished)
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    replica: Optional[str] = None       # current placement
+    rid: Optional[int] = None           # engine-local id
+    finished: bool = False
+    error: Optional[BaseException] = None
+    deadline_abs: Optional[float] = None  # router clock
+    migrations: int = 0
+
+    @property
+    def done(self) -> bool:
+        return self.finished or self.error is not None
+
+
+class Router:
+    """Spread an open-loop request stream over N engine replicas.
+
+    ``engines`` may be LLMEngine instances or (name, engine) pairs;
+    ``heartbeat_timeout`` is the silence (on ``clock``) after which a
+    replica with a stalled beat counter is declared dead;
+    ``step_timeout_s`` optionally bounds each replica's step wall-clock
+    via ``run_with_deadline`` (a blown budget kills the replica);
+    ``locality_prefix`` is the prompt-prefix length used for
+    cache-locality placement.
+    """
+
+    def __init__(self, engines, *, names: Optional[List[str]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 heartbeat_timeout: float = 10.0,
+                 step_timeout_s: Optional[float] = None,
+                 locality_prefix: int = 8):
+        self._clock = clock
+        self.step_timeout_s = step_timeout_s
+        self.locality_prefix = int(locality_prefix)
+        self._replicas: "OrderedDict[str, EngineReplica]" = OrderedDict()
+        pairs = []
+        for i, e in enumerate(engines):
+            if isinstance(e, tuple):
+                pairs.append(e)
+            else:
+                pairs.append((names[i] if names else f"replica{i}", e))
+        for name, eng in pairs:
+            self._replicas[name] = EngineReplica(name=name, engine=eng)
+        if not self._replicas:
+            raise ValueError("router needs at least one engine replica")
+        self._tracker = HeartbeatTracker(heartbeat_timeout, clock=clock)
+        self._requests: Dict[int, RouterRequest] = {}
+        # (replica, rid) -> rr: the active placement index
+        self._placed: Dict[Tuple[str, int], RouterRequest] = {}
+        # submitted but currently unplaceable (mid-failover overload)
+        self._orphans: Deque[RouterRequest] = deque()
+        self._steps = 0
+
+    # -- introspection ---------------------------------------------------
+    def replica_states(self) -> Dict[str, str]:
+        return {n: r.state.value for n, r in self._replicas.items()}
+
+    def live_replicas(self) -> List[str]:
+        return [n for n, r in self._replicas.items()
+                if r.state is ReplicaState.LIVE]
+
+    def output_of(self, gid: int) -> List[int]:
+        return list(self._requests[gid].tokens)
+
+    def error_of(self, gid: int) -> Optional[BaseException]:
+        return self._requests[gid].error
+
+    def is_finished(self, gid: int) -> bool:
+        return self._requests[gid].finished
+
+    def has_work(self) -> bool:
+        if self._orphans:
+            return True
+        return any(not rr.done for rr in self._requests.values())
+
+    # -- placement -------------------------------------------------------
+    def _prefix_key(self, prompt: List[int]) -> Tuple[int, ...]:
+        return tuple(prompt[:self.locality_prefix])
+
+    def _rank_replicas(self, prompt: List[int]) -> List[EngineReplica]:
+        """Live replicas, least-loaded first, with a locality bonus
+        when the prompt prefix was recently placed on the replica (its
+        kv pages are warm there — prefix-cache groundwork)."""
+        key = self._prefix_key(prompt)
+        ranked = []
+        for rep in self._replicas.values():
+            if rep.state is not ReplicaState.LIVE:
+                continue
+            sch = rep.engine.scheduler
+            load = sch.num_waiting + sch.num_running
+            score = float(load) - (0.5 if key in rep.prefixes else 0.0)
+            ranked.append((score, len(ranked), rep))
+        ranked.sort(key=lambda t: (t[0], t[1]))
+        return [rep for _, _, rep in ranked]
+
+    def _place(self, rr: RouterRequest) -> bool:
+        """Try to seat rr on the best live replica.  False when no
+        replica could take it (all shed, or none live)."""
+        prompt = rr.prompt + rr.tokens
+        remaining = rr.max_new_tokens - len(rr.tokens)
+        deadline_s = None
+        if rr.deadline_abs is not None:
+            deadline_s = rr.deadline_abs - self._clock()
+            if deadline_s <= 0:
+                rr.error = DeadlineExceeded(
+                    f"request {rr.gid} deadline passed during "
+                    f"placement ({len(rr.tokens)} tokens streamed)")
+                return True  # terminal — nothing to place
+        for rep in self._rank_replicas(prompt):
+            try:
+                rid = rep.engine.add_request(
+                    prompt, remaining, eos_token_id=rr.eos_token_id,
+                    on_token=self._stream_cb(rr), deadline_s=deadline_s)
+            except AdmissionRejected:
+                continue
+            key = self._prefix_key(prompt)
+            rep.prefixes[key] = None
+            rep.prefixes.move_to_end(key)
+            while len(rep.prefixes) > _PREFIX_LRU:
+                rep.prefixes.popitem(last=False)
+            rr.replica, rr.rid = rep.name, rid
+            self._placed[(rep.name, rid)] = rr
+            return True
+        return False
+
+    def _stream_cb(self, rr: RouterRequest) -> Callable:
+        def cb(rid, token, finished):
+            rr.tokens.append(int(token))
+            if finished:
+                rr.finished = True
+            if rr.on_token is not None:
+                rr.on_token(rr.gid, int(token), bool(finished))
+        return cb
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token_id: Optional[int] = None,
+               on_token: Optional[Callable] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Admit one stream; returns its gid.  Raises
+        :class:`AdmissionRejected` when every live replica sheds and
+        :class:`ReplicaUnavailable` when none is live."""
+        if not self.live_replicas():
+            raise ReplicaUnavailable("no live replica to place on")
+        rr = RouterRequest(
+            gid=next(_GIDS), prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id, on_token=on_token,
+            deadline_abs=(None if deadline_s is None
+                          else self._clock() + float(deadline_s)))
+        if not self._place(rr):
+            raise AdmissionRejected(
+                f"all {len(self.live_replicas())} live replicas are "
+                f"shedding — retry with backoff")
+        self._requests[rr.gid] = rr
+        return rr.gid
+
+    # -- liveness / failure handling -------------------------------------
+    def observe_beat(self, name: str) -> None:
+        """External-replica hook: record one unit of step progress for
+        a replica the router does not step in-process."""
+        self._replicas[name].beats += 1
+
+    def check_health(self) -> List[str]:
+        """Declare replicas whose beat counter stalled past the
+        heartbeat timeout dead (observer-clock rule — no cross-host
+        clock needed) and fail their requests over.  Returns newly
+        dead replica names."""
+        newly = []
+        for name, rep in self._replicas.items():
+            if rep.state is not ReplicaState.LIVE:
+                continue
+            silent = self._tracker.observe(name, rep.beats)
+            if silent > self._tracker.timeout_s:
+                self._mark_dead(name, reason=(
+                    f"heartbeat silent {silent:.1f}s "
+                    f"(> {self._tracker.timeout_s:.1f}s)"))
+                newly.append(name)
+        return newly
+
+    def _active_on(self, name: str) -> List[RouterRequest]:
+        return [rr for (rep, _), rr in list(self._placed.items())
+                if rep == name and not rr.done]
+
+    def _mark_dead(self, name: str, reason: str) -> None:
+        rep = self._replicas[name]
+        if rep.state is ReplicaState.DEAD:
+            return
+        rep.state = ReplicaState.DEAD
+        self._tracker.forget(name)
+        _engine._STATS["replicas_dead"] += 1
+        record_incident("serve_replica_dead", replica=name,
+                        reason=reason[:200])
+        if _metrics.enabled():
+            _metrics.counter("serve_replica_dead_total",
+                             "Replicas declared dead",
+                             replica=name).inc()
+        victims = self._active_on(name)
+        _LOG.warning("replica %s dead (%s); failing over %d request(s)",
+                     name, reason, len(victims))
+        for rr in victims:
+            self._failover(rr)
+
+    def _failover(self, rr: RouterRequest) -> None:
+        """Move one in-flight stream off its (dead/draining) replica.
+        Idempotent by construction: the resubmitted prompt is
+        ``prompt + delivered``, so the continuation starts exactly
+        after the last token the caller already received."""
+        self._placed.pop((rr.replica, rr.rid), None)
+        rr.replica = rr.rid = None
+        rr.migrations += 1
+        _engine._STATS["failovers"] += 1
+        if _metrics.enabled():
+            _metrics.counter("serve_failovers_total",
+                             "In-flight requests migrated off a dead "
+                             "or draining replica").inc()
+        if len(rr.tokens) >= rr.max_new_tokens or rr.finished:
+            rr.finished = True
+            return
+        if not self._place(rr):
+            self._orphans.append(rr)  # retried every step
+
+    def drain(self, name: str) -> int:
+        """Preemption notice for one replica: stop placing on it and
+        migrate its queued + in-flight requests to live replicas.
+        Returns the number of requests migrated."""
+        rep = self._replicas[name]
+        if rep.state is not ReplicaState.LIVE:
+            return 0
+        rep.state = ReplicaState.DRAINING
+        _engine._STATS["drains"] += 1
+        record_incident("serve_replica_drain", replica=name)
+        if _metrics.enabled():
+            _metrics.counter("serve_drains_total",
+                             "Replica drains (preemption notices)",
+                             replica=name).inc()
+        victims = self._active_on(name)
+        for rr in victims:
+            # the replica is still alive — release its pages/slot so
+            # the remaining steps (if any) don't waste them
+            rep.engine.cancel(rr.rid)
+            self._failover(rr)
+        return len(victims)
+
+    def install_sigterm_drain(self, name: Optional[str] = None):
+        """SIGTERM → drain: ``name`` when the notice is for one
+        replica, else every live replica (whole-process preemption).
+        Chains any previously-installed handler."""
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            targets = [name] if name is not None else self.live_replicas()
+            for t in targets:
+                self.drain(t)
+            if callable(prev):
+                prev(signum, frame)
+
+        signal.signal(signal.SIGTERM, _handler)
+        return _handler
+
+    # -- the step loop ---------------------------------------------------
+    def step(self) -> List[int]:
+        """One router iteration: step every live replica (each step is
+        a heartbeat), harvest completions and engine-terminal errors,
+        fail over replicas that died, retry orphans.  Returns the gids
+        that finished this step."""
+        finished_gids: List[int] = []
+        self._steps += 1
+        for name in list(self._replicas):
+            rep = self._replicas[name]
+            if rep.state is not ReplicaState.LIVE:
+                continue
+            try:
+                chaos_point(f"serve.replica.{name}.step",
+                            step=self._steps, replica=name)
+                if self.step_timeout_s is not None:
+                    rids = run_with_deadline(
+                        rep.engine.step, self.step_timeout_s,
+                        phase=f"serve.replica.{name}", dump=False)
+                else:
+                    rids = rep.engine.step()
+            except Exception as exc:  # noqa: BLE001 — replica failure
+                self._mark_dead(name, reason=f"{type(exc).__name__}: "
+                                             f"{exc}")
+                continue
+            rep.beats += 1
+            for rid in rids:
+                rr = self._placed.get((name, rid))
+                if rr is not None:
+                    rr.finished = True
+                    finished_gids.append(rr.gid)
+            # engine-terminal states (quarantine, deadline, cancel)
+            # surface on the router request — never retried
+            for rr in self._active_on(name):
+                st = rep.engine.state_of(rr.rid)
+                if st is RequestState.FAILED:
+                    rr.error = rep.engine.error_of(rr.rid)
+                    self._placed.pop((name, rr.rid), None)
+                elif st is RequestState.CANCELLED:
+                    rr.error = rr.error or DeadlineExceeded(
+                        f"request {rr.gid} cancelled on {name}")
+                    self._placed.pop((name, rr.rid), None)
+        self.check_health()
+        for _ in range(len(self._orphans)):
+            rr = self._orphans.popleft()
+            if rr.done:
+                continue
+            if not self._place(rr):
+                self._orphans.append(rr)
+                break  # nobody can take them this step
+        return finished_gids
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:
+        """Step until every submitted stream is terminal (or
+        max_steps); returns gid -> delivered tokens."""
+        steps = 0
+        while self.has_work():
+            if max_steps is not None and steps >= max_steps:
+                break
+            self.step()
+            steps += 1
+        return {gid: list(rr.tokens)
+                for gid, rr in self._requests.items()}

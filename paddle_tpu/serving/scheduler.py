@@ -43,6 +43,8 @@ class RequestState(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
     FINISHED = "finished"
+    CANCELLED = "cancelled"   # cooperative cancel at a step boundary
+    FAILED = "failed"         # deadline expiry or quarantine; see .error
 
 
 @dataclasses.dataclass
@@ -58,6 +60,8 @@ class Request:
     arrival_s: float = 0.0
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
+    deadline_s: Optional[float] = None    # absolute, engine clock
+    error: Optional[BaseException] = None  # set when state is FAILED
 
     @property
     def known(self) -> List[int]:
@@ -89,6 +93,10 @@ class StepPlan:
     seqs: List[ScheduledSeq]            # occupied slots only
     bucket: int                         # compiled Tc for this step
     preempted: List[Request] = dataclasses.field(default_factory=list)
+    # waiting requests that free slots could seat but the page pool
+    # could not cover — they stay queued (never dropped); the engine
+    # counts these as admission waits
+    admission_blocked: int = 0
 
 
 class Scheduler:
@@ -111,6 +119,13 @@ class Scheduler:
             raise ValueError(
                 f"request needs {total} tokens > max_model_len "
                 f"{self.max_model_len}")
+        if _cdiv(total, self.kv.page_size) > self.kv.allocator.capacity:
+            # genuine misconfiguration, caught at admission — this
+            # request could never run even alone on an empty pool
+            raise ValueError(
+                f"single request exceeds pool capacity: {total} tokens "
+                f"need {_cdiv(total, self.kv.page_size)} pages, pool "
+                f"has {self.kv.allocator.capacity}")
         if not req.prompt:
             raise ValueError("empty prompt")
         self.waiting.append(req)
@@ -147,6 +162,46 @@ class Scheduler:
         self.slots[slot] = None
         self.kv.release(req.rid)
 
+    # -- lifecycle ------------------------------------------------------
+    def remove(self, req: Request, now_s: float = 0.0,
+               state: RequestState = RequestState.CANCELLED,
+               error: Optional[BaseException] = None) -> None:
+        """Terminal removal at a step boundary (cancel / deadline /
+        quarantine): free pages and slot if running, drop from the
+        queue if waiting, stamp the terminal state."""
+        if req.rid in self._slot_of:
+            self._release_slot(req)
+        else:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                pass
+        req.state = state
+        req.error = error
+        req.finish_s = now_s
+
+    def reset_running(self) -> List[Request]:
+        """Pool-rebuild support: demote every running request back to
+        WAITING with fed=0 (full history replay), in slot order.  Does
+        NOT touch the kv cache — the caller is replacing it wholesale
+        (after a failed step the donated pools are suspect)."""
+        demoted: List[Request] = []
+        for slot in range(self.max_running):
+            req = self.slots[slot]
+            if req is None:
+                continue
+            self.slots[slot] = None
+            req.state = RequestState.WAITING
+            req.fed = 0
+            demoted.append(req)
+        self._slot_of.clear()
+        return demoted
+
+    def requeue_front(self, reqs: List[Request]) -> None:
+        """Put requests at the head of the queue, preserving order."""
+        for req in reversed(reqs):
+            self.waiting.appendleft(req)
+
     # -- the step boundary ---------------------------------------------
     def finish(self, req: Request, now_s: float = 0.0) -> None:
         """Completion at a step boundary: free pages, open the slot."""
@@ -168,13 +223,22 @@ class Scheduler:
             while not self.kv.grow(req.rid, target):
                 victim = self._evict_youngest(but_not=req)
                 if victim is None:
-                    raise RuntimeError(
-                        "single request exceeds pool capacity — "
-                        "max_model_len over-provisioned for the pool")
+                    # alone and still can't grow — another tenant holds
+                    # the pages (chaos `exhaust`, a co-located engine):
+                    # preempt *itself* rather than crash; add() already
+                    # rejected requests that could never fit, so this
+                    # replays once pages free up
+                    self._release_slot(req)
+                    req.state = RequestState.WAITING
+                    req.fed = 0
+                    self.waiting.appendleft(req)
+                    preempted.append(req)
+                    break
                 preempted.append(victim)
 
         # 2) continuous admission into free slots, behind a watermark
         # of one decode page per running request
+        admission_blocked = 0
         while self.waiting and self.num_running < self.max_running:
             req = self.waiting[0]
             first = min(self.chunk, req.num_known)
@@ -183,8 +247,10 @@ class Scheduler:
                 1 for r in self.slots if r is not None
                 and self.kv.pages_needed(r.rid, r.fed + 1))
             if self.kv.allocator.num_free - need < watermark:
+                admission_blocked = len(self.waiting)
                 break
             if not self.kv.grow(req.rid, first):
+                admission_blocked = len(self.waiting)
                 break
             self.waiting.popleft()
             slot = self.slots.index(None)
@@ -203,7 +269,8 @@ class Scheduler:
                 seq_len=req.fed + q_len,
                 produces=req.fed + q_len == req.num_known))
         bucket = self.chunk if any(s.q_len > 1 for s in seqs) else 1
-        return StepPlan(seqs=seqs, bucket=bucket, preempted=preempted)
+        return StepPlan(seqs=seqs, bucket=bucket, preempted=preempted,
+                        admission_blocked=admission_blocked)
 
     def apply(self, plan: StepPlan, next_tokens: Dict[int, int],
               now_s: float = 0.0) -> List[Request]:

@@ -20,6 +20,15 @@ and every matching point fires an injected fault:
                 rank / transient straggler, recovers on its own
     disconnect  raise ConnectionResetError — transient store failure
     truncate    truncate the file at the point's ``path``
+    fail        alias of ``raise`` (the serving spelling:
+                ``fail@serve.step:rid=K`` blames one request)
+    kill        raise ReplicaKilled — whole-replica death; the serving
+                router must fail over, not retry the step
+    exhaust     grab every free page of the hit's ``pool=`` allocator
+                (noisy neighbour); ``Chaos.release_exhausted()`` frees
+
+Serving rules can carry ``rid=K`` to fire only when request id K is in
+the hit's batch (the ``rids=`` kwarg) — deterministic bisection blame.
 
 Gang-aware options: ``rank=`` fires only on that trainer
 (``PADDLE_TRAINER_ID``) and ``restart=`` only in that elastic
@@ -57,12 +66,12 @@ import time
 from contextlib import contextmanager
 from typing import Iterable, List, Optional, Union
 
-__all__ = ["Chaos", "ChaosError", "Rule", "chaos_point", "install",
-           "uninstall", "active", "installed", "install_from_env",
-           "truncate_file", "corrupt_file"]
+__all__ = ["Chaos", "ChaosError", "ReplicaKilled", "Rule", "chaos_point",
+           "install", "uninstall", "active", "installed",
+           "install_from_env", "truncate_file", "corrupt_file"]
 
 ACTIONS = ("crash", "raise", "sigterm", "hang", "stall", "disconnect",
-           "truncate")
+           "truncate", "fail", "kill", "exhaust")
 
 # injectable so infinite-hang tests can count chunks instead of sleeping
 _SLEEP = time.sleep
@@ -70,7 +79,13 @@ _HANG_CHUNK_S = 60.0
 
 
 class ChaosError(RuntimeError):
-    """Injected in-process fault (the ``raise`` action)."""
+    """Injected in-process fault (the ``raise``/``fail`` actions)."""
+
+
+class ReplicaKilled(ChaosError):
+    """Injected replica death (the ``kill`` action) — the serving
+    router's failover path must treat the whole replica as dead, not
+    just retry the step."""
 
 
 class Rule:
@@ -83,7 +98,8 @@ class Rule:
                  sleep_s: Optional[float] = None,
                  rank: Optional[int] = None,
                  restart: Optional[int] = None,
-                 resize: Optional[int] = None):
+                 resize: Optional[int] = None,
+                 rid: Optional[int] = None):
         if action not in ACTIONS:
             raise ValueError(f"unknown chaos action {action!r}; "
                              f"one of {ACTIONS}")
@@ -107,11 +123,15 @@ class Rule:
         self.resize = None if resize is None else int(resize)
         if self.resize is not None and self.resize < 1:
             raise ValueError(f"resize={self.resize} must be >= 1")
+        # `rid=` restricts serving-step rules to hits whose batch
+        # contains that request id — makes bisection blame deterministic
+        self.rid = None if rid is None else int(rid)
         self.hits = 0    # matching visits (post step-filter)
         self.fired = 0   # times the fault actually fired
+        self.held_pages: list = []  # pages grabbed by `exhaust`
 
     _INT_KEYS = {"step", "times", "after", "exit_code", "rank", "restart",
-                 "resize"}
+                 "resize", "rid"}
     _FLOAT_KEYS = {"prob", "frac", "sleep_s", "secs"}
 
     @classmethod
@@ -162,7 +182,7 @@ class Chaos:
         return self
 
     def hit(self, point: str, step: Optional[int] = None,
-            path: Optional[str] = None, **_kw):
+            path: Optional[str] = None, **kw):
         # gang gating read at fire time (once per hit, not per rule):
         # PTQ_CHAOS is inherited by every rank and every elastic
         # generation, so rules carry their own rank/restart filters
@@ -177,6 +197,8 @@ class Chaos:
                 continue
             if r.restart is not None and env_restart != r.restart:
                 continue
+            if r.rid is not None and r.rid not in (kw.get("rids") or ()):
+                continue
             r.hits += 1
             if r.hits <= r.after:
                 continue
@@ -186,18 +208,39 @@ class Chaos:
                 continue
             r.fired += 1
             self.log.append((point, step, r.action))
-            self._fire(r, point, step, path)
+            self._fire(r, point, step, path, kw)
 
-    def _fire(self, r: Rule, point: str, step, path):
+    def release_exhausted(self):
+        """Free every page grabbed by fired ``exhaust`` rules — the
+        test's stand-in for other tenants' requests finishing."""
+        for r in self.rules:
+            for alloc, pages in r.held_pages:
+                alloc.free(pages)
+            r.held_pages.clear()
+
+    def _fire(self, r: Rule, point: str, step, path, kw):
         if r.resize is not None:
             _request_resize(r.resize)
         if r.action == "crash":
             # the real thing: no cleanup, no atexit, no flush — exactly
             # what a preempted VM or OOM-killed worker looks like
             os._exit(r.exit_code)
-        if r.action == "raise":
+        if r.action in ("raise", "fail"):
             raise ChaosError(f"chaos: injected crash at {point} "
                              f"(step={step})")
+        if r.action == "kill":
+            raise ReplicaKilled(f"chaos: replica killed at {point} "
+                                f"(step={step})")
+        if r.action == "exhaust":
+            # steal every free pool page (kw["pool"] is the serving
+            # BlockAllocator) — the noisy-neighbour / fragmentation
+            # shape; release_exhausted() gives them back
+            alloc = kw.get("pool")
+            if alloc is not None and alloc.num_free:
+                pages = alloc.alloc(alloc.num_free, owner="__chaos__")
+                if pages:
+                    r.held_pages.append((alloc, pages))
+            return
         if r.action == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
             return

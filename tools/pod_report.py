@@ -64,6 +64,11 @@ def _parse_args(argv=None):
     ap.add_argument("--page-size", type=int, default=128,
                     help="paged-KV tokens per pool page for the "
                          "serving capacity section")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas behind a serving.Router — "
+                         "the serving section reports router-level "
+                         "aggregate capacity (N x plan_capacity) "
+                         "alongside the per-engine numbers")
     ap.add_argument("--topology", default=None,
                     help="override the planner: dp,pp,sharding,mp")
     ap.add_argument("--out", default="-",
@@ -364,6 +369,17 @@ def _serving_section(cfg, gen, args):
     plan["weights_gib"] = round(plan["weights_bytes"] / 2**30, 2)
     plan["usable_kv_gib"] = round(plan["usable_kv_bytes"] / 2**30, 2)
     plan["fits"] = plan["max_concurrent_requests"] > 0
+    # router-level view: N independent replicas behind serving.Router
+    # multiply concurrency and pool pages linearly (each replica owns
+    # its own chip and pool); per-request numbers are per-engine
+    n = max(int(getattr(args, "replicas", 1) or 1), 1)
+    plan["replicas"] = n
+    plan["aggregate"] = {
+        "max_concurrent_requests":
+            n * plan["max_concurrent_requests"],
+        "num_pages": n * plan["num_pages"],
+        "usable_kv_bytes": n * plan["usable_kv_bytes"],
+    }
     return plan
 
 
