@@ -22,6 +22,14 @@ past it), _TTFT_SLO_MS / _LAT_SLO_MS (SLO targets checked in the
 resilience block), and PADDLE_TPU_BENCH_TIMEOUT for the watchdog
 deadline shared with bench.py.
 
+``--workload shared-prefix`` (or _WORKLOAD=shared-prefix) switches the
+prompt mix to N requests over M shared system prompts (_SYS_PROMPTS,
+default 2) and turns on the PR-12 reuse stack — prefix caching plus
+self-draft speculative decoding (_SPEC_K, default 3; the draft IS the
+target, so acceptance isolates the machinery from draft quality).  The
+JSON line then carries a ``reuse`` block: prefix hit-rate, prefill
+tokens saved, and the spec-decode acceptance rate.
+
 The JSON line carries a ``resilience`` block (shed / recoveries /
 quarantined / deadline-expired counts for the measured run, plus the
 observed-vs-target SLO verdicts) so overload and chaos E2E runs are
@@ -81,12 +89,22 @@ def main():
 
     preset = os.environ.get("PADDLE_TPU_BENCH_SERVE_PRESET",
                             "llama-debug")
+    workload = os.environ.get("PADDLE_TPU_BENCH_SERVE_WORKLOAD",
+                              "uniform")
+    if "--workload" in sys.argv:
+        workload = sys.argv[sys.argv.index("--workload") + 1]
+    if workload not in ("uniform", "shared-prefix"):
+        raise ValueError(f"unknown --workload {workload!r} "
+                         "(uniform | shared-prefix)")
+    shared = workload == "shared-prefix"
     n_req = _env_int("REQUESTS", 16)
     max_prompt = _env_int("PROMPT", 24)
     n_new = _env_int("NEW", 16)
     max_running = _env_int("MAX_RUNNING", 8)
     chunk = _env_int("CHUNK", 8)
     page = _env_int("PAGE", 16)
+    n_sys = _env_int("SYS_PROMPTS", 2)
+    spec_k = _env_int("SPEC_K", 3)
     max_queue = _env_int("MAX_QUEUE", 8 * max_running)
     pages_env = os.environ.get("PADDLE_TPU_BENCH_SERVE_PAGES")
     ttft_slo = os.environ.get("PADDLE_TPU_BENCH_SERVE_TTFT_SLO_MS")
@@ -94,8 +112,9 @@ def main():
 
     dev = jax.devices()[0]
     n_chips = jax.device_count()
-    _log(f"backend={dev.platform} preset={preset} requests={n_req} "
-         f"max_running={max_running} chunk={chunk} page={page}")
+    _log(f"backend={dev.platform} preset={preset} workload={workload} "
+         f"requests={n_req} max_running={max_running} chunk={chunk} "
+         f"page={page}")
 
     cfg = llama.preset(preset)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -104,24 +123,53 @@ def main():
     slo = serving.SLOConfig(
         ttft_p95_s=float(ttft_slo) / 1e3 if ttft_slo else None,
         latency_p95_s=float(lat_slo) / 1e3 if lat_slo else None)
+    reuse_kw = {}
+    if shared:
+        # self-draft: the draft model IS the target, so every proposal
+        # verifies (acceptance rate ~1) — the bench isolates the spec
+        # machinery's cost/benefit from draft-model quality
+        reuse_kw = dict(prefix_cache=True,
+                        spec=serving.SpecDecodeConfig(
+                            cfg=cfg, params=params, k=spec_k))
     eng = serving.LLMEngine(cfg, params, max_running=max_running,
                             chunk=chunk, page_size=page,
                             num_pages=int(pages_env) if pages_env
                             else None,
                             max_model_len=max_model_len,
-                            max_queue=max_queue, slo=slo)
+                            max_queue=max_queue, slo=slo, **reuse_kw)
 
     rng = np.random.RandomState(0)
-    prompts = [list(rng.randint(0, cfg.vocab_size,
-                                rng.randint(2, max_prompt + 1)))
-               for _ in range(n_req)]
+    if shared:
+        # N requests over M distinct system prompts: the shared head is
+        # most of the prompt (the few-shot/system-prompt shape), the
+        # tail is per-request
+        sys_len = max(max_prompt * 3 // 4, 2)
+        sys_prompts = [list(rng.randint(0, cfg.vocab_size, sys_len))
+                       for _ in range(n_sys)]
+        prompts = [
+            sys_prompts[i % n_sys]
+            + list(rng.randint(0, cfg.vocab_size,
+                               rng.randint(1, max(max_prompt - sys_len,
+                                                  1) + 1)))
+            for i in range(n_req)]
+    else:
+        prompts = [list(rng.randint(0, cfg.vocab_size,
+                                    rng.randint(2, max_prompt + 1)))
+                   for _ in range(n_req)]
 
-    # warmup: compile both buckets before the clock starts
-    wid = eng.add_request(prompts[0], 2)
+    # warmup: compile both buckets before the clock starts.  In
+    # shared-prefix mode warmup also runs one request per system
+    # prompt, so the radix cache holds every shared head before the
+    # measured run — the production shape, where system prompts are
+    # warm long before the traffic being measured
+    if shared:
+        warm_ids = [eng.add_request(list(sp), 2) for sp in sys_prompts]
+    else:
+        warm_ids = [eng.add_request(prompts[0], 2)]
     while eng.has_work():
         eng.step()
     _log(f"warmup done ({len(eng._step_fns)} bucket(s) compiled), "
-         f"warm tokens {eng.output_of(wid)}")
+         f"warm tokens {eng.output_of(warm_ids[0])}")
     # drop the warmup's compile-inflated observations so the reported
     # percentiles describe steady-state serving only
     from paddle_tpu.profiler import metrics as _m
@@ -187,6 +235,25 @@ def main():
     def _ms(v):
         return None if v is None else round(v * 1e3, 2)
 
+    # work-reuse report (measured-run deltas): prefix hit-rate over
+    # the admitted prompt tokens — every hit token is a prefill token
+    # the engine never fed — and the spec-decode acceptance rate
+    hit = int(stats_now["prefix_hit_tokens"] - base["prefix_hit_tokens"])
+    proposed = int(stats_now["spec_proposed"] - base["spec_proposed"])
+    accepted = int(stats_now["spec_accepted"] - base["spec_accepted"])
+    prompt_tokens = sum(len(r.prompt) for r in reqs)
+    reuse = {
+        "prefix_hit_tokens": hit,
+        "prompt_tokens": prompt_tokens,
+        "prefix_hit_rate": (round(hit / prompt_tokens, 4)
+                            if prompt_tokens else 0.0),
+        "prefill_tokens_saved": hit,
+        "spec_proposed": proposed,
+        "spec_accepted": accepted,
+        "spec_acceptance_rate": (round(accepted / proposed, 4)
+                                 if proposed else 0.0),
+    }
+
     rep = eng.slo_report()
     res["slo"] = {
         "ttft_p95_ms": _ms(rep["ttft_p95_s"]),
@@ -208,6 +275,8 @@ def main():
         "requests": len(rids),
         "shed_submits": shed_submits,
         "max_queue": max_queue,
+        "workload": workload,
+        "reuse": reuse,
         "resilience": res,
         "tokens": tokens,
         "steps": steps,

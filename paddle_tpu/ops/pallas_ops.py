@@ -2239,6 +2239,14 @@ def kernel_verify_cases():
     cases.append(("ragged_paged_attention", mixed_fn, mixed_avals))
     cases.append(("ragged_paged_attention_decode", decode_fn,
                   decode_avals))
+    # the speculative-decoding verify bucket: the target checks k draft
+    # tokens in one step as a short ragged prefill (Tc = 1 + k; k = 3
+    # matches SpecDecodeConfig's default).  Same kernel, distinct
+    # compiled shape — registering it keeps the Level-3 sweep proving
+    # the block-table index maps at the shape serving actually runs.
+    spec_fn, spec_avals = rpa_case(4)
+    cases.append(("ragged_paged_attention_spec_verify", spec_fn,
+                  spec_avals))
     return cases
 
 

@@ -36,16 +36,21 @@ from .errors import (AdmissionRejected, DeadlineExceeded,  # noqa: F401
                      RetriableError, ServingError)
 from .kv_cache import (BlockAllocator, PagedKVCache,  # noqa: F401
                        kv_bytes_per_token, plan_capacity)
+from .prefix_cache import PrefixCache, PrefixStats  # noqa: F401
 from .router import (EngineReplica, ReplicaState, Router,  # noqa: F401
                      RouterRequest)
 from .scheduler import (Request, RequestState,  # noqa: F401
                         ScheduledSeq, Scheduler, StepPlan)
+from .spec_decode import (DraftModel, SpecDecodeConfig,  # noqa: F401
+                          greedy_accept)
 
 __all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
            "summary_lines",
            "BlockAllocator", "PagedKVCache", "kv_bytes_per_token",
            "plan_capacity", "Request", "RequestState", "Scheduler",
            "StepPlan", "ScheduledSeq",
+           "PrefixCache", "PrefixStats",
+           "SpecDecodeConfig", "DraftModel", "greedy_accept",
            "Router", "RouterRequest", "ReplicaState", "EngineReplica",
            "ServingError", "RetriableError", "AdmissionRejected",
            "DeadlineExceeded", "RequestQuarantined",

@@ -65,6 +65,7 @@ from .errors import (AdmissionRejected, DeadlineExceeded,
                      RequestQuarantined)
 from .kv_cache import PagedKVCache, _cdiv, kv_bytes_per_token
 from .scheduler import Request, RequestState, Scheduler, StepPlan
+from .spec_decode import DraftModel, SpecDecodeConfig, greedy_accept
 
 __all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
            "summary_lines"]
@@ -83,6 +84,9 @@ def _stats_zero() -> Dict[str, float]:
         "requests_preempted": 0, "steps": 0, "prefill_tokens": 0,
         "decode_tokens": 0, "peak_running": 0, "pool_bytes": 0,
         "compiled_buckets": 0,
+        # work reuse (prefix cache + speculative decoding)
+        "prefix_hit_tokens": 0, "prefix_evicted_pages": 0,
+        "spec_proposed": 0, "spec_accepted": 0,
         # resilience counters (this module + serving/router.py)
         "shed": 0, "admission_waits": 0, "callback_errors": 0,
         "recoveries": 0, "quarantined": 0, "deadline_expired": 0,
@@ -121,6 +125,12 @@ def summary_lines() -> List[str]:
     lines.append(
         f"  kv pools: {s['pool_bytes'] / 2**20:.1f} MiB  "
         f"compiled buckets: {int(s['compiled_buckets'])}")
+    if s["prefix_hit_tokens"] or s["spec_proposed"]:
+        lines.append(
+            f"  reuse: {int(s['prefix_hit_tokens'])} prefix-hit tokens "
+            f"({int(s['prefix_evicted_pages'])} pages evicted)  "
+            f"spec: {int(s['spec_accepted'])}/{int(s['spec_proposed'])} "
+            f"drafts accepted")
     lines.append(
         f"  resilience: {int(s['recoveries'])} recoveries  "
         f"{int(s['quarantined'])} quarantined  "
@@ -189,6 +199,15 @@ class LLMEngine:
     (default ``8 * max_running``), ``slo`` carries TTFT/latency targets
     and the default per-request deadline, ``watchdog`` overrides the
     flag-gated global watchdog for the ``serve.step`` phase.
+
+    Work-reuse knobs (both default off; outputs stay bit-identical to
+    plain greedy decode either way): ``prefix_cache=True`` turns on
+    shared-prefix KV reuse — admission matches each prompt against the
+    radix cache and only prefills the uncached tail
+    (``serving/prefix_cache.py``); ``spec=SpecDecodeConfig(...)``
+    attaches a draft model for speculative decoding — every decode row
+    widens to a 1+k verify chunk through the prefill bucket
+    (``serving/spec_decode.py``).
     """
 
     def __init__(self, cfg, params, *, max_running: int = 8,
@@ -199,7 +218,9 @@ class LLMEngine:
                  clock: Callable[[], float] = time.monotonic,
                  max_queue: Optional[int] = None,
                  slo: Optional[SLOConfig] = None,
-                 watchdog: Optional[Watchdog] = None):
+                 watchdog: Optional[Watchdog] = None,
+                 prefix_cache: bool = False,
+                 spec: Optional["SpecDecodeConfig"] = None):
         from ..models import llama as _llama
 
         self.cfg = cfg
@@ -253,6 +274,30 @@ class LLMEngine:
         self._step_fns: Dict[int, Callable] = {}
         self._requests: Dict[int, Request] = {}
         self._steps = 0
+
+        # -- work reuse: shared-prefix KV cache + speculative decoding
+        self._prefix_enabled = bool(prefix_cache)
+        if self._prefix_enabled:
+            self.kv.enable_prefix_cache()
+        self._copy_fn = None           # COW page copy on the target pools
+        self._evicted_seen = 0
+        self._draft: Optional[DraftModel] = None
+        self._spec_k = 0
+        if spec is not None:
+            if spec.cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {spec.cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}")
+            if not 1 <= spec.k < self.chunk:
+                raise ValueError(
+                    f"spec.k={spec.k} must satisfy 1 <= k < chunk="
+                    f"{self.chunk} (the verify chunk 1+k rides the "
+                    "prefill bucket)")
+            self._draft = DraftModel(
+                spec.cfg, spec.params, num_pages=self.num_pages,
+                page_size=self.page_size, donate=self._donate)
+            self._spec_k = int(spec.k)
+            self.scheduler.spec_k = self._spec_k
 
         _STATS["engines"] += 1
         _STATS["pool_bytes"] += pool_bytes
@@ -348,9 +393,14 @@ class LLMEngine:
             last = jnp.clip(qlens - 1, 0, tokens.shape[1] - 1)
             rows = jnp.take_along_axis(
                 logits, last[:, None, None], axis=1)[:, 0]   # [R, V]
+            # argmax at EVERY fed position [R, Tc]: position q_len-1 is
+            # the sampled token (same value the old per-row argmax
+            # gave); the earlier positions are what spec-decode
+            # verification reads — multi-token verify needs the
+            # target's choice after each draft token
             # chk: one float per row (max logit) — a cheap [R] transfer
             # the numerics watchdog scans for NaN/Inf poisoning
-            return (jnp.argmax(rows, axis=-1).astype(jnp.int32),
+            return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
                     jnp.max(rows, axis=-1), kp, vp)
 
         fn = jax.jit(step, donate_argnums=(2, 3) if self._donate else ())
@@ -359,19 +409,45 @@ class LLMEngine:
         return fn
 
     @staticmethod
-    def _batch_arrays(seqs, R: int, Tc: int, Bmax: int, kv):
-        """Host-side input assembly for one step over ``seqs``."""
+    def _batch_arrays(seqs, R: int, Tc: int, Bmax: int, kv,
+                      drafts: Optional[Dict[int, List[int]]] = None):
+        """Host-side input assembly for one step over ``seqs``.  A
+        spec row feeds its one known token followed by the draft's
+        proposals (the verify chunk)."""
         tokens = np.zeros((R, Tc), np.int32)
         tbl = np.zeros((R, Bmax), np.int32)
         lens = np.zeros((R,), np.int32)
         qlens = np.zeros((R,), np.int32)
         for s in seqs:
             req = s.request
-            tokens[s.slot, :s.q_len] = req.known[req.fed:req.fed + s.q_len]
+            if getattr(s, "spec", 0) and drafts is not None:
+                row = (req.known[req.fed:req.fed + 1]
+                       + drafts[s.slot][:s.q_len - 1])
+            else:
+                row = req.known[req.fed:req.fed + s.q_len]
+            tokens[s.slot, :s.q_len] = row
             tbl[s.slot] = kv.block_row(req.rid)
             lens[s.slot] = s.seq_len
             qlens[s.slot] = s.q_len
         return tokens, tbl, lens, qlens
+
+    def _apply_copies(self, pairs) -> None:
+        """Execute COW page forks on device, target pools and (when
+        speculative decoding is on) draft pools — the same page pair,
+        so a donated page always carries both models' kv.  One compile:
+        src/dst are traced scalars, not baked constants."""
+        if self._copy_fn is None:
+            def cp(kp, vp, s, d):
+                return (kp.at[:, :, d].set(kp[:, :, s]),
+                        vp.at[:, :, d].set(vp[:, :, s]))
+
+            self._copy_fn = jax.jit(
+                cp, donate_argnums=(0, 1) if self._donate else ())
+        for src, dst in pairs:
+            self._kp, self._vp = self._copy_fn(
+                self._kp, self._vp, jnp.int32(src), jnp.int32(dst))
+            if self._draft is not None:
+                self._draft.copy_page(src, dst)
 
     def _wd(self) -> Optional[Watchdog]:
         if self._watchdog is not None:
@@ -415,17 +491,26 @@ class LLMEngine:
                     "serve_admission_wait_total",
                     "Steps where free slots waited on pool pages").inc(
                     )
+        # COW forks from this schedule's prefix matches must land on
+        # device before any forward reads (or the allocator recycles)
+        # the pages involved
+        pairs = self.kv.drain_copies()
+        if pairs:
+            self._apply_copies(pairs)
         if not plan.seqs:
             return []
         R, Tc = self.max_running, plan.bucket
+        drafts: Optional[Dict[int, List[int]]] = None
+        if self._draft is not None:
+            spec_rows = [
+                (s.slot, s.request.known[s.request.fed], s.request.fed,
+                 self.kv.block_row(s.request.rid))
+                for s in plan.seqs if s.spec]
+            if spec_rows:
+                drafts = self._draft.propose(
+                    spec_rows, self._spec_k, R, self.max_blocks)
         tokens, tbl, lens, qlens = self._batch_arrays(
-            plan.seqs, R, Tc, self.max_blocks, self.kv)
-        prefill = decode = 0
-        for s in plan.seqs:
-            if s.q_len == 1 and s.produces:
-                decode += 1
-            else:
-                prefill += s.q_len
+            plan.seqs, R, Tc, self.max_blocks, self.kv, drafts)
 
         try:
             nxt = self._guarded_forward(plan, tokens, tbl, lens, qlens,
@@ -437,10 +522,33 @@ class LLMEngine:
         except Exception as exc:  # noqa: BLE001 — classified in _recover
             return self._recover(plan, exc)
 
+        if self._draft is not None:
+            # mirror: the draft ingests the exact same feed, so its kv
+            # tracks the target's fed counter in lockstep (donated
+            # pages then carry valid draft kv for future borrowers)
+            self._draft.forward(tokens, tbl, lens, qlens)
+
         now = self._clock()
-        finished = self.scheduler.apply(
-            plan, {s.slot: nxt[s.slot] for s in plan.seqs if s.produces},
-            now_s=now)
+        out: Dict[int, object] = {}
+        prefill = decode = 0
+        spec_proposed = spec_accepted = 0
+        for s in plan.seqs:
+            if s.spec:
+                row = [int(t) for t in nxt[s.slot, :s.q_len]]
+                emitted = greedy_accept(drafts[s.slot], row)
+                out[s.slot] = emitted
+                spec_proposed += s.spec
+                spec_accepted += len(emitted) - 1
+                decode += len(emitted)
+            elif s.produces:
+                out[s.slot] = int(nxt[s.slot, s.q_len - 1])
+                if s.q_len == 1:
+                    decode += 1
+                else:
+                    prefill += s.q_len
+            else:
+                prefill += s.q_len
+        finished = self.scheduler.apply(plan, out, now_s=now)
         self._steps += 1
 
         _STATS["steps"] += 1
@@ -450,6 +558,13 @@ class LLMEngine:
         _STATS["requests_finished"] += len(finished)
         _STATS["peak_running"] = max(_STATS["peak_running"],
                                      len(plan.seqs))
+        _STATS["prefix_hit_tokens"] += plan.prefix_hit_tokens
+        _STATS["spec_proposed"] += spec_proposed
+        _STATS["spec_accepted"] += spec_accepted
+        if self._prefix_enabled:
+            ev = self.kv.prefix.stats.evicted_pages
+            _STATS["prefix_evicted_pages"] += ev - self._evicted_seen
+            self._evicted_seen = ev
         for s in plan.seqs:
             r = s.request
             if r.first_token_s is not None and r.first_token_s == now:
@@ -472,6 +587,20 @@ class LLMEngine:
                     "serve_preemptions_total",
                     "Requests preempted for pool pressure").inc(
                     len(plan.preempted))
+            if plan.prefix_hit_tokens:
+                _metrics.counter(
+                    "serve_prefix_hit_tokens_total",
+                    "Prompt tokens served from the prefix cache").inc(
+                    plan.prefix_hit_tokens)
+            if spec_proposed:
+                _metrics.counter(
+                    "serve_spec_proposed_total",
+                    "Draft tokens proposed for verification").inc(
+                    spec_proposed)
+                _metrics.counter(
+                    "serve_spec_accepted_total",
+                    "Draft tokens accepted by the target").inc(
+                    spec_accepted)
             for s in plan.seqs:
                 r = s.request
                 if (r.first_token_s is not None
@@ -537,12 +666,21 @@ class LLMEngine:
         and all host page state; rebuild both from scratch and demote
         every running request to the front of the queue with fed=0 —
         the unified fed/known path then replays prompt + generated
-        tokens, bit-identical under greedy decode."""
+        tokens, bit-identical under greedy decode.  The prefix trie is
+        rebuilt empty (its pages lived in the suspect pools) and the
+        draft pools reset with it — replays re-prefill and re-mirror
+        from scratch, so the reuse machinery cannot alter the replayed
+        streams."""
         self.kv = PagedKVCache(self.num_pages, self.page_size,
                                self.max_blocks)
+        if self._prefix_enabled:
+            self.kv.enable_prefix_cache()
+            self._evicted_seen = 0
         self.scheduler.kv = self.kv
         self._kp = jnp.zeros(self._pool_shape, self._kv_dtype)
         self._vp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        if self._draft is not None:
+            self._draft.reset()
         demoted = self.scheduler.reset_running()
         self.scheduler.requeue_front(demoted)
         return demoted
@@ -680,12 +818,23 @@ class LLMEngine:
         return {rid: list(r.output) for rid, r in self._requests.items()
                 if not r.state.value == "waiting"}
 
+    def prefix_lookup(self, prompt) -> int:
+        """How many tokens of ``prompt`` this engine's prefix cache
+        would serve without prefill (0 when the cache is off).  Side-
+        effect free — the router's locality-placement signal."""
+        if self.kv.prefix is None:
+            return 0
+        return self.kv.prefix.peek([int(t) for t in prompt])
+
     def shutdown(self) -> None:
         """Drop the pools and their xmem reservation."""
         _STATS["pool_bytes"] -= self._pool_bytes
         _xmem.record_reservation("serving.kv_pages", 0)
         self._kp = self._vp = None
         self._step_fns.clear()
+        self._copy_fn = None
+        if self._draft is not None:
+            self._draft.shutdown()
 
 
 @dataclasses.dataclass
@@ -696,6 +845,7 @@ class _ProbeSeq:
     request: Request
     slot: int
     q_len: int
+    spec: int = 0
 
     @property
     def seq_len(self) -> int:

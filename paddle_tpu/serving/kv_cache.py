@@ -36,12 +36,21 @@ def _cdiv(a: int, b: int) -> int:
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_pages`` pool pages.
+    """Refcounted free-list allocator over ``num_pages`` pool pages.
 
-    Invariants (asserted by tests/test_serving.py):
+    Pages start single-owner (``alloc`` hands them out at refcount 1)
+    and become shared through ``incref`` — the prefix cache borrows a
+    cached page for every request reading it, plus one reference for
+    the trie itself.  A page returns to the free list only when the
+    last reference drops.
+
+    Invariants (asserted by tests/test_serving.py and
+    tests/test_prefix_spec.py):
       * page 0 is never allocated (the null page),
-      * a page is owned by at most one request,
-      * capacity == num_pages - 1, and free + allocated == capacity.
+      * no page is freed while its refcount is > 1 (``free`` raises;
+        ``decref`` only recycles at zero),
+      * capacity == num_pages - 1, and free + allocated == capacity,
+        where allocated counts distinct pages with refcount >= 1.
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -52,7 +61,8 @@ class BlockAllocator:
         # LIFO free list: recently-freed pages are reused first, which
         # keeps the working set of pool pages small
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._owner: Dict[int, object] = {}
+        self._owner: Dict[int, object] = {}   # allocating owner (debug)
+        self._ref: Dict[int, int] = {}
 
     @property
     def capacity(self) -> int:
@@ -64,24 +74,61 @@ class BlockAllocator:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._owner)
+        return len(self._ref)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
+    def is_held(self, page: int) -> bool:
+        return page in self._ref
+
     def alloc(self, n: int, owner=None) -> Optional[List[int]]:
-        """Pop n pages, or None (and no change) if fewer are free."""
+        """Pop n pages at refcount 1, or None (and no change) if fewer
+        are free."""
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._owner[p] = owner
+            self._ref[p] = 1
         return pages
 
-    def free(self, pages: List[int]) -> None:
+    def incref(self, pages: List[int]) -> None:
         for p in pages:
-            if p == 0 or p not in self._owner:
+            if p == 0 or p not in self._ref:
+                raise ValueError(f"incref of page {p} not allocated")
+            self._ref[p] += 1
+
+    def decref(self, pages: List[int]) -> List[int]:
+        """Drop one reference per page; pages whose count reaches zero
+        go back to the free list.  Returns the pages actually freed."""
+        freed: List[int] = []
+        for p in pages:
+            if p == 0 or p not in self._ref:
+                raise ValueError(f"decref of page {p} not allocated")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                del self._owner[p]
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    def free(self, pages: List[int]) -> None:
+        """Single-owner release: refuses shared pages outright, so a
+        caller that never took extra references keeps the old exact
+        semantics (and a double free still raises)."""
+        for p in pages:
+            if p == 0 or p not in self._ref:
                 raise ValueError(f"freeing page {p} not allocated")
+            if self._ref[p] != 1:
+                raise ValueError(
+                    f"freeing page {p} with refcount {self._ref[p]} — "
+                    "shared pages must be released via decref")
+            del self._ref[p]
             del self._owner[p]
             self._free.append(p)
 
@@ -90,6 +137,7 @@ class BlockAllocator:
 class _Entry:
     pages: List[int]           # pool pages, in logical-block order
     num_tokens: int = 0        # kv tokens written so far
+    shared: int = 0            # leading pages borrowed from the trie
 
 
 class PagedKVCache:
@@ -97,13 +145,30 @@ class PagedKVCache:
     list, plus the [R, Bmax] block-table assembly the kernel consumes.
     The device pools themselves are owned by the engine (they thread
     through the jitted step as donated arrays); this class never holds
-    device memory."""
+    device memory.
+
+    With a ``PrefixCache`` attached (``enable_prefix_cache``),
+    ``match_prefix`` seeds a new request's block list from the trie —
+    full cached pages are borrowed (one reference each), a partially
+    matching page is forked copy-on-write into a private page whose
+    device copy the engine drains before the next forward — and
+    ``donate`` retires a finished request's full pages into the trie
+    instead of freeing them."""
 
     def __init__(self, num_pages: int, page_size: int, max_blocks: int):
         self.allocator = BlockAllocator(num_pages, page_size)
         self.page_size = int(page_size)
         self.max_blocks = int(max_blocks)    # Bmax of the block table
         self._table: Dict[object, _Entry] = {}
+        self.prefix = None                   # Optional[PrefixCache]
+        # COW forks awaiting their device copy: (src_page, dst_page);
+        # one src reference is held per pending pair until drained
+        self._pending_copies: List[tuple] = []
+
+    def enable_prefix_cache(self):
+        from .prefix_cache import PrefixCache
+        self.prefix = PrefixCache(self.allocator, self.page_size)
+        return self.prefix
 
     # -- allocation ------------------------------------------------------
     def pages_needed(self, rid, target_tokens: int) -> int:
@@ -130,12 +195,97 @@ class PagedKVCache:
         """Record that rid's kv is written up to num_tokens."""
         self._table[rid].num_tokens = num_tokens
 
+    # -- prefix cache ----------------------------------------------------
+    def match_prefix(self, rid, tokens: List[int]) -> int:
+        """Seed rid's block list from the prefix cache: borrow every
+        fully matching cached page, fork a partially matching one
+        copy-on-write.  Returns the number of tokens whose kv the
+        request inherits (0 when the cache is off, rid already has
+        pages, or nothing matches); the request must re-feed everything
+        past that point."""
+        if self.prefix is None or rid in self._table:
+            return 0
+        pages, matched, partial = self.prefix.match(tokens)
+        entry_pages = list(pages)
+        total = matched
+        if partial is not None:
+            src, plen = partial
+            got = self.allocator.alloc(1, owner=rid)
+            if got is None:
+                # no private page for the fork — keep the full-page hit
+                self.prefix.release_partial(src)
+            else:
+                # the src reference taken by match() is held until the
+                # engine drains this pair (drain_copies) or the request
+                # is released before the copy ran
+                self._pending_copies.append((src, got[0]))
+                entry_pages.append(got[0])
+                total += plen
+                self.prefix.stats.forks += 1
+        if not entry_pages:
+            return 0
+        self._table[rid] = _Entry(pages=entry_pages, num_tokens=total,
+                                  shared=len(pages))
+        return total
+
+    def drain_copies(self) -> List[tuple]:
+        """Hand the engine the (src_page, dst_page) COW pairs to copy
+        on device, dropping the src references.  The caller MUST apply
+        the copies before the next forward pass or allocation — after
+        this call a src page may be evicted or recycled."""
+        pairs, self._pending_copies = self._pending_copies, []
+        for src, _dst in pairs:
+            self.allocator.decref([src])
+        return pairs
+
+    def donate(self, rid, tokens: List[int], valid_tokens: int) -> int:
+        """Completion path with the cache on: full pages covering the
+        first ``valid_tokens`` of ``tokens`` (the kv actually written —
+        speculative scratch past it is never donated) move into the
+        trie; the remainder is released.  Returns pages donated."""
+        entry = self._table.pop(rid, None)
+        if entry is None:
+            return 0
+        self._drop_pending_for(entry)
+        full = min(valid_tokens // self.page_size, len(entry.pages))
+        donated = entry.pages[:full]
+        if self.prefix is not None and donated:
+            self.prefix.insert(tokens[:full * self.page_size], donated)
+        else:
+            self.allocator.decref(donated)
+        self.allocator.decref(entry.pages[full:])
+        return len(donated)
+
+    def evict_cached(self, num_pages: int) -> int:
+        """Ask the trie to reclaim up to num_pages unreferenced cached
+        pages (LRU).  No-op without a cache."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.evict(num_pages)
+
+    def _drop_pending_for(self, entry: _Entry) -> None:
+        """Cancel COW copies whose destination belongs to a request
+        being torn down before the copy ran; their src refs drop."""
+        if not self._pending_copies:
+            return
+        mine = set(entry.pages)
+        keep: List[tuple] = []
+        for src, dst in self._pending_copies:
+            if dst in mine:
+                self.allocator.decref([src])
+            else:
+                keep.append((src, dst))
+        self._pending_copies = keep
+
     def release(self, rid) -> List[int]:
-        """Free all of rid's pages (completion or preemption)."""
+        """Drop all of rid's references (completion without donation,
+        preemption, cancel).  Shared pages stay alive for the trie and
+        any sibling readers; uniquely-owned pages return to the pool."""
         entry = self._table.pop(rid, None)
         if entry is None:
             return []
-        self.allocator.free(entry.pages)
+        self._drop_pending_for(entry)
+        self.allocator.decref(entry.pages)
         return entry.pages
 
     def num_tokens(self, rid) -> int:
@@ -145,6 +295,33 @@ class PagedKVCache:
         """One block-table row, padded with the null page to Bmax."""
         pages = self._table[rid].pages if rid in self._table else []
         return (pages + [0] * self.max_blocks)[:self.max_blocks]
+
+    def audit(self) -> dict:
+        """Snapshot of the capacity invariant: every allocated page is
+        either uniquely owned by one request, shared between requests
+        and the trie, or cached with only the trie's reference — and
+        ``free + unique_owned + shared + cached_idle == capacity``.
+        ``ok`` is False when pages leak outside those states (e.g. a
+        foreign owner holds pool pages)."""
+        held = set()
+        for e in self._table.values():
+            held.update(e.pages)
+        cached = set(self.prefix.cached_pages()) if self.prefix else set()
+        free = self.allocator.num_free
+        unique = len(held - cached)
+        sharedc = len(held & cached)
+        idle = len(cached - held)
+        return {
+            "free": free,
+            "unique_owned": unique,
+            "shared": sharedc,
+            "cached_idle": idle,
+            "capacity": self.allocator.capacity,
+            "ok": (free + unique + sharedc + idle
+                   == self.allocator.capacity
+                   and self.allocator.num_allocated
+                   == unique + sharedc + idle),
+        }
 
 
 # ---------------------------------------------------------------------------

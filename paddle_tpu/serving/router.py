@@ -168,9 +168,14 @@ class Router:
         return tuple(prompt[:self.locality_prefix])
 
     def _rank_replicas(self, prompt: List[int]) -> List[EngineReplica]:
-        """Live replicas, least-loaded first, with a locality bonus
-        when the prompt prefix was recently placed on the replica (its
-        kv pages are warm there — prefix-cache groundwork)."""
+        """Live replicas, least-loaded first, with a cache-locality
+        bonus.  When a replica's engine runs a prefix cache, the bonus
+        is the *real* hit statistic — ``engine.prefix_lookup(prompt)``
+        asks the radix trie how many prompt tokens it would serve
+        without prefill, scaled to [0, 1] — so shared-system-prompt
+        traffic converges on the replica already holding those pages.
+        Without a cache the heuristic stays exactly what PR 11 shipped:
+        0.5 for a recently-placed prompt prefix (LRU)."""
         key = self._prefix_key(prompt)
         ranked = []
         for rep in self._replicas.values():
@@ -178,7 +183,15 @@ class Router:
                 continue
             sch = rep.engine.scheduler
             load = sch.num_waiting + sch.num_running
-            score = float(load) - (0.5 if key in rep.prefixes else 0.0)
+            lookup = getattr(rep.engine, "prefix_lookup", None)
+            hit = lookup(prompt) if lookup is not None else 0
+            if hit > 0:
+                bonus = min(hit / max(len(prompt), 1), 1.0)
+            elif key in rep.prefixes:
+                bonus = 0.5
+            else:
+                bonus = 0.0
+            score = float(load) - bonus
             ranked.append((score, len(ranked), rep))
         ranked.sort(key=lambda t: (t[0], t[1]))
         return [rep for _, _, rep in ranked]

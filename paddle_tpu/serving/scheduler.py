@@ -86,6 +86,11 @@ class ScheduledSeq:
     q_len: int      # tokens fed this step
     seq_len: int    # kv length after this step (fed + q_len)
     produces: bool  # True when the step closes the gap and samples
+    # speculative verify chunk: q_len = 1 + spec where the trailing
+    # ``spec`` tokens are draft proposals the target verifies this step
+    # (multi-token verification is a short ragged prefill, so the row
+    # rides the Tc=chunk bucket — no new compiled shape)
+    spec: int = 0
 
 
 @dataclasses.dataclass
@@ -97,6 +102,9 @@ class StepPlan:
     # could not cover — they stay queued (never dropped); the engine
     # counts these as admission waits
     admission_blocked: int = 0
+    # prompt tokens served from the prefix cache by this step's
+    # admissions (the engine folds these into serve_prefix_* metrics)
+    prefix_hit_tokens: int = 0
 
 
 class Scheduler:
@@ -111,6 +119,10 @@ class Scheduler:
         # fixed slot array: index == batch row of the compiled step
         self.slots: List[Optional[Request]] = [None] * self.max_running
         self._slot_of: Dict[int, int] = {}
+        # speculative lookahead: when > 0, every pure-decode row is
+        # widened to a verify chunk of 1 + spec_k tokens (the engine
+        # sets this iff a draft model is attached)
+        self.spec_k: int = 0
 
     # -- queue ----------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -143,7 +155,27 @@ class Scheduler:
 
     # -- internals ------------------------------------------------------
     def _q_len(self, req: Request) -> int:
-        return min(self.chunk, req.num_known - req.fed)
+        gap = req.num_known - req.fed
+        q = min(self.chunk, gap)
+        if (self.spec_k > 0 and gap == 1
+                and 1 + self.spec_k <= self.chunk
+                and req.fed + 1 + self.spec_k <= self.max_model_len
+                and _cdiv(req.fed + 1 + self.spec_k, self.kv.page_size)
+                <= self.kv.max_blocks):
+            q = 1 + self.spec_k
+        return q
+
+    def _try_grow(self, req: Request, target: int) -> bool:
+        """grow(), with one LRU sweep of unreferenced cached pages when
+        the free list alone cannot cover the target — eviction under
+        watermark pressure, before any preemption."""
+        if self.kv.grow(req.rid, target):
+            return True
+        deficit = (self.kv.pages_needed(req.rid, target)
+                   - self.kv.allocator.num_free)
+        if deficit > 0 and self.kv.evict_cached(deficit):
+            return self.kv.grow(req.rid, target)
+        return False
 
     def _evict_youngest(self, but_not: Request) -> Optional[Request]:
         for slot in range(self.max_running - 1, -1, -1):
@@ -160,7 +192,14 @@ class Scheduler:
     def _release_slot(self, req: Request) -> None:
         slot = self._slot_of.pop(req.rid)
         self.slots[slot] = None
-        self.kv.release(req.rid)
+        if self.kv.prefix is not None and req.fed >= self.kv.page_size:
+            # donate the valid full pages (fed tokens of kv) so a
+            # preempted request keeps its prefix hit on replay and a
+            # finished request seeds future siblings; the trie holds
+            # them at refcount "idle", so eviction can still reclaim
+            self.kv.donate(req.rid, req.known, req.fed)
+        else:
+            self.kv.release(req.rid)
 
     # -- lifecycle ------------------------------------------------------
     def remove(self, req: Request, now_s: float = 0.0,
@@ -220,7 +259,7 @@ class Scheduler:
             if req is None:
                 continue
             target = req.fed + self._q_len(req)
-            while not self.kv.grow(req.rid, target):
+            while not self._try_grow(req, target):
                 victim = self._evict_youngest(but_not=req)
                 if victim is None:
                     # alone and still can't grow — another tenant holds
@@ -237,21 +276,37 @@ class Scheduler:
                 preempted.append(victim)
 
         # 2) continuous admission into free slots, behind a watermark
-        # of one decode page per running request
+        # of one decode page per running request.  The prefix cache is
+        # consulted first: the matched head of the prompt is borrowed
+        # (refcounts bumped, nothing allocated), so the request is
+        # charged — in both pages and watermark math — only for its
+        # uncached tail.
         admission_blocked = 0
+        prefix_hit_tokens = 0
         while self.waiting and self.num_running < self.max_running:
             req = self.waiting[0]
-            first = min(self.chunk, req.num_known)
-            need = _cdiv(first, self.kv.page_size)
+            matched = self.kv.match_prefix(req.rid, req.known)
+            if matched:
+                req.fed = matched
+            first = req.fed + min(self.chunk, req.num_known - req.fed)
+            need = self.kv.pages_needed(req.rid, first)
             watermark = sum(
                 1 for r in self.slots if r is not None
                 and self.kv.pages_needed(r.rid, r.fed + 1))
-            if self.kv.allocator.num_free - need < watermark:
+            deficit = need + watermark - self.kv.allocator.num_free
+            if deficit > 0:
+                self.kv.evict_cached(deficit)
+            if (self.kv.allocator.num_free - need < watermark
+                    or not self.kv.grow(req.rid, first)):
+                if matched:
+                    # undo the borrow: drop the refs (and any pending
+                    # COW fork) so the blocked request re-matches when
+                    # it is eventually seated
+                    self.kv.release(req.rid)
+                    req.fed = 0
                 admission_blocked = len(self.waiting)
                 break
-            if not self.kv.grow(req.rid, first):
-                admission_blocked = len(self.waiting)
-                break
+            prefix_hit_tokens += matched
             self.waiting.popleft()
             slot = self.slots.index(None)
             self.slots[slot] = req
@@ -264,35 +319,56 @@ class Scheduler:
             if req is None:
                 continue
             q_len = self._q_len(req)
+            gap = req.num_known - req.fed
             seqs.append(ScheduledSeq(
                 request=req, slot=slot, q_len=q_len,
                 seq_len=req.fed + q_len,
-                produces=req.fed + q_len == req.num_known))
+                produces=req.fed + q_len >= req.num_known,
+                spec=q_len - gap if gap == 1 and q_len > 1 else 0))
         bucket = self.chunk if any(s.q_len > 1 for s in seqs) else 1
         return StepPlan(seqs=seqs, bucket=bucket, preempted=preempted,
-                        admission_blocked=admission_blocked)
+                        admission_blocked=admission_blocked,
+                        prefix_hit_tokens=prefix_hit_tokens)
 
-    def apply(self, plan: StepPlan, next_tokens: Dict[int, int],
+    def apply(self, plan: StepPlan, next_tokens: Dict[int, object],
               now_s: float = 0.0) -> List[Request]:
         """Commit a computed step: advance fed counters, append sampled
         tokens, fire callbacks, finish completed requests.
         ``next_tokens`` maps slot -> sampled token id for slots whose
-        step produced one.  Returns the requests that finished."""
+        step produced one; a *spec verify* slot maps to the accepted
+        token list instead (1..spec+1 tokens, in stream order).
+        Returns the requests that finished."""
         finished: List[Request] = []
         for s in plan.seqs:
             req = s.request
-            req.fed = s.seq_len
-            self.kv.commit(req.rid, s.seq_len)
             if not s.produces:
+                req.fed = s.seq_len
+                self.kv.commit(req.rid, req.fed)
                 continue
-            tok = int(next_tokens[s.slot])
-            req.output.append(tok)
-            if req.first_token_s is None:
-                req.first_token_s = now_s
+            out = next_tokens[s.slot]
+            toks = ([int(t) for t in out] if isinstance(out, (list, tuple))
+                    else [int(out)])
+            appended = 0
+            for tok in toks:
+                req.output.append(tok)
+                appended += 1
+                if req.first_token_s is None:
+                    req.first_token_s = now_s
+                done = req.done
+                if req.on_token is not None:
+                    req.on_token(req.rid, tok, done)
+                if done:
+                    break
+            # a verify chunk's kv is valid only through the accepted
+            # tokens — the rejected tail is stale scratch the next
+            # step's feed overwrites before any read.  Non-spec rows
+            # keep the exact old bookkeeping: every fed token's kv is
+            # real, fed advances by the full chunk.
+            req.fed = (s.seq_len - s.q_len + appended if s.spec
+                       else s.seq_len)
+            self.kv.commit(req.rid, req.fed)
             if req.done:
                 finished.append(req)
-            if req.on_token is not None:
-                req.on_token(req.rid, tok, req.done)
         for req in finished:
             self.finish(req, now_s)
         return finished

@@ -1,0 +1,188 @@
+"""Speculative decoding: a draft model proposes, the target verifies.
+
+Reference analog: Leviathan et al. speculative sampling, restricted to
+the greedy case so the acceptance rule needs no rejection sampling and
+the engine's output stays **bit-identical** to plain decode — the same
+guarantee PR 11's crash-recovery replay relies on.
+
+The mechanics fit the serving engine with no new kernel:
+
+* The draft model keeps its **own device pools but the target's page
+  ids** — same ``num_pages``/``page_size``, same ``BlockAllocator``,
+  same block tables.  Every engine step mirrors the target's exact
+  feed through the draft (one extra forward per step, same Tc bucket),
+  so the draft's kv tracks the target's fed counter in lockstep: no
+  catch-up pass, prefix-cache pages donated by one request carry valid
+  draft kv for the next, and a pool rebuild resets both sides at once.
+* **Proposal** is k sequential draft decodes over the running batch
+  (the Tc=1 bucket, all speculating slots at once), writing draft kv
+  at positions ``fed..fed+k-1`` through the already-grown block
+  tables.
+* **Verification** is the target forward over ``[x0, d1..dk]`` at
+  positions ``fed..fed+k`` — exactly a short ragged prefill through
+  the existing mixed Tc=chunk bucket (``ScheduledSeq.spec`` marks the
+  row; the scheduler widened it before growth, so pages cover it).
+
+Greedy acceptance (``greedy_accept``): with target argmax rows
+``g_0..g_k`` (``g_i`` = argmax after feeding token i of the chunk),
+accept drafts while ``d_i == g_{i-1}`` and emit ``g_0..g_a`` — by
+induction each emitted token is exactly what single-token greedy
+decode would have produced, because once ``d_i`` equals the token
+plain decode would have fed, position i's kv and logits coincide with
+the plain-decode step.  Rejected positions leave stale kv past the
+new ``fed``, which the unified fed/known path overwrites before any
+read (sequence lengths never cover unwritten positions).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SpecDecodeConfig", "DraftModel", "greedy_accept"]
+
+
+@dataclasses.dataclass
+class SpecDecodeConfig:
+    """Draft-model settings for one engine.
+
+    ``cfg``/``params`` are any llama-family config + params with the
+    **same vocabulary** as the target (asserted at engine init); ``k``
+    is the lookahead — each pure-decode row is widened to a verify
+    chunk of ``1 + k`` tokens, so ``k`` must stay below the engine's
+    prefill ``chunk``."""
+
+    cfg: object
+    params: object
+    k: int = 3
+
+
+class DraftModel:
+    """Device-side half of speculative decoding: draft pools shaped by
+    the draft config but indexed by the *target's* page ids, plus the
+    compiled draft forwards (one per Tc bucket, like the engine's)."""
+
+    def __init__(self, cfg, params, *, num_pages: int, page_size: int,
+                 donate: bool = False):
+        from ..models import llama as _llama
+
+        self.cfg = cfg
+        self.params = params
+        self._fwd = _llama.forward_paged
+        self._pool_shape = (cfg.num_hidden_layers,
+                            cfg.num_key_value_heads,
+                            int(num_pages), int(page_size),
+                            cfg.head_dim)
+        self._kv_dtype = cfg.dtype
+        self._donate = bool(donate)
+        self._kp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        self._vp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        self._fns: Dict[int, object] = {}
+        self._copy_fn = None
+
+    def reset(self) -> None:
+        """Zero the draft pools (engine pool rebuild: both sides replay
+        from scratch so draft kv stays in lockstep with the target)."""
+        self._kp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        self._vp = jnp.zeros(self._pool_shape, self._kv_dtype)
+
+    def _fn(self, Tc: int):
+        fn = self._fns.get(Tc)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+
+        def step(params, tokens, kp, vp, tbl, lens, qlens):
+            logits, (kp, vp) = fwd(cfg, params, tokens, kp, vp, tbl,
+                                   lens, qlens)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
+        fn = jax.jit(step,
+                     donate_argnums=(2, 3) if self._donate else ())
+        self._fns[Tc] = fn
+        return fn
+
+    def forward(self, tokens, tbl, lens, qlens) -> np.ndarray:
+        """One draft forward over the [R, Tc] batch: writes draft kv
+        for every fed position, returns host argmax [R, Tc].  Used both
+        to mirror the target's feed (output discarded) and as the
+        proposal step (Tc == 1)."""
+        out, self._kp, self._vp = self._fn(tokens.shape[1])(
+            self.params, jnp.asarray(tokens), self._kp, self._vp,
+            jnp.asarray(tbl), jnp.asarray(lens), jnp.asarray(qlens))
+        return np.asarray(out)
+
+    def copy_page(self, src, dst) -> None:
+        """Copy-on-write fork on the draft pools (same page pair the
+        target copied, so donated pages keep valid draft kv).  src/dst
+        arrive as traced int32 scalars — one compile total."""
+        if self._copy_fn is None:
+            def cp(kp, vp, s, d):
+                return (kp.at[:, :, d].set(kp[:, :, s]),
+                        vp.at[:, :, d].set(vp[:, :, s]))
+
+            self._copy_fn = jax.jit(
+                cp, donate_argnums=(0, 1) if self._donate else ())
+        self._kp, self._vp = self._copy_fn(
+            self._kp, self._vp, jnp.int32(src), jnp.int32(dst))
+
+    def propose(self, rows: List[Tuple[int, int, int, List[int]]],
+                k: int, R: int, Bmax: int) -> Dict[int, List[int]]:
+        """k sequential greedy draft decodes for the speculating slots.
+
+        ``rows`` is ``(slot, last_token, fed, block_row)`` per row —
+        the draft feeds ``last_token`` at position ``fed`` (its kv is
+        valid through ``fed - 1`` by the mirror invariant) and chains
+        its own argmax k times, writing draft kv as it goes.  Returns
+        slot -> the k proposed token ids."""
+        tokens = np.zeros((R, 1), np.int32)
+        tbl = np.zeros((R, Bmax), np.int32)
+        lens = np.zeros((R,), np.int32)
+        qlens = np.zeros((R,), np.int32)
+        cur: Dict[int, int] = {}
+        pos: Dict[int, int] = {}
+        for slot, last_tok, fed, block_row in rows:
+            tbl[slot] = block_row
+            cur[slot] = int(last_tok)
+            pos[slot] = int(fed)
+            qlens[slot] = 1
+        drafts: Dict[int, List[int]] = {slot: [] for slot in cur}
+        for _ in range(k):
+            for slot in cur:
+                tokens[slot, 0] = cur[slot]
+                lens[slot] = pos[slot] + 1
+            out = self.forward(tokens, tbl, lens, qlens)
+            for slot in cur:
+                d = int(out[slot, 0])
+                drafts[slot].append(d)
+                cur[slot] = d
+                pos[slot] += 1
+        return drafts
+
+    def shutdown(self) -> None:
+        self._kp = self._vp = None
+        self._fns.clear()
+        self._copy_fn = None
+
+
+def greedy_accept(drafts: List[int], target_row: List[int]) -> List[int]:
+    """The rejection-sampling-free acceptance rule.
+
+    ``target_row`` holds the target's argmax at each verify position:
+    ``g_0`` after the real last token, ``g_i`` after draft ``d_i``.
+    Emit ``g_0``; then accept drafts left to right while
+    ``d_i == g_{i-1}`` (the draft guessed exactly the token plain
+    greedy decode would have fed next), emitting ``g_i`` for each.
+    The first mismatch stops — everything after it was conditioned on
+    a token plain decode would never have produced.  Output is
+    therefore always a prefix of (and at least one token of) what
+    plain greedy decode emits: bit-identical streams."""
+    emitted = [int(target_row[0])]
+    for i, d in enumerate(drafts):
+        if int(d) != int(target_row[i]):
+            break
+        emitted.append(int(target_row[i + 1]))
+    return emitted

@@ -212,10 +212,18 @@ class Chaos:
 
     def release_exhausted(self):
         """Free every page grabbed by fired ``exhaust`` rules — the
-        test's stand-in for other tenants' requests finishing."""
+        test's stand-in for other tenants' requests finishing.
+
+        Refcount-aware: chaos drops only the ONE reference it took at
+        ``exhaust`` time (decref, never a hard free), and skips pages
+        some other path already recycled — so releasing the chaos
+        tenant can never free a page a sibling request or the prefix
+        cache still reads."""
         for r in self.rules:
             for alloc, pages in r.held_pages:
-                alloc.free(pages)
+                held = [p for p in pages if alloc.is_held(p)]
+                if held:
+                    alloc.decref(held)
             r.held_pages.clear()
 
     def _fire(self, r: Rule, point: str, step, path, kw):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import re
 import sys
@@ -69,6 +70,14 @@ def _parse_args(argv=None):
                          "the serving section reports router-level "
                          "aggregate capacity (N x plan_capacity) "
                          "alongside the per-engine numbers")
+    ap.add_argument("--prefix-hit-rate", type=float, default=None,
+                    help="measured shared-prefix hit rate in [0, 1) "
+                         "(e.g. the prefix_hit_rate from bench_serve "
+                         "--workload shared-prefix) — the serving "
+                         "section then also reports effective "
+                         "blocks-per-request and concurrency with "
+                         "that fraction of each request's pages "
+                         "shared from the radix cache")
     ap.add_argument("--topology", default=None,
                     help="override the planner: dp,pp,sharding,mp")
     ap.add_argument("--out", default="-",
@@ -369,6 +378,23 @@ def _serving_section(cfg, gen, args):
     plan["weights_gib"] = round(plan["weights_bytes"] / 2**30, 2)
     plan["usable_kv_gib"] = round(plan["usable_kv_bytes"] / 2**30, 2)
     plan["fits"] = plan["max_concurrent_requests"] > 0
+    # measured prefix-hit-rate folds into capacity: a hit fraction h
+    # means h of each request's pages come from the radix cache and
+    # are shared, so only (1-h) of blocks_per_request are unique per
+    # request.  Raw numbers stay in the report next to the effective
+    # ones — the raw plan is the zero-reuse worst case
+    hit = getattr(args, "prefix_hit_rate", None)
+    if hit is not None:
+        if not 0.0 <= hit < 1.0:
+            raise SystemExit(
+                f"--prefix-hit-rate {hit} out of range [0, 1)")
+        raw_blocks = plan["blocks_per_request"]
+        eff_blocks = max(int(math.ceil(raw_blocks * (1.0 - hit))), 1)
+        n_pages = plan["num_pages"]
+        eff_concurrent = (n_pages - 1) // eff_blocks if n_pages > 1 else 0
+        plan["prefix_hit_rate"] = float(hit)
+        plan["effective_blocks_per_request"] = eff_blocks
+        plan["effective_max_concurrent_requests"] = int(eff_concurrent)
     # router-level view: N independent replicas behind serving.Router
     # multiply concurrency and pool pages linearly (each replica owns
     # its own chip and pool); per-request numbers are per-engine
