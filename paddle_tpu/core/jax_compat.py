@@ -1,0 +1,65 @@
+"""Version shims for the jax surface paddle_tpu relies on.
+
+The codebase targets the modern jax API where ``shard_map`` is a
+top-level export taking ``check_vma=`` / ``axis_names=``. Older jax
+(<= 0.4.x) only ships ``jax.experimental.shard_map.shard_map`` with the
+pre-rename ``check_rep=`` / ``auto=`` parameters. ``ensure()`` installs
+a translating wrapper as ``jax.shard_map`` when the top-level name is
+missing, so every call site can use one spelling regardless of the
+installed jax.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["ensure"]
+
+_installed = False
+
+
+def _adapt_shard_map(legacy_shard_map):
+    @functools.wraps(legacy_shard_map)
+    def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None, **kwargs):
+        kw = dict(kwargs)
+        # check_vma (new name) -> check_rep (old name)
+        kw.setdefault("check_rep", check_vma)
+        # axis_names (new: axes made manual, rest auto/GSPMD) has no
+        # sound legacy translation: 0.4.x's `auto=` mode cannot lower
+        # axis_index under SPMD partitioning ("PartitionId instruction
+        # is not supported"). Degrade to fully-manual over every mesh
+        # axis — numerically identical (axes absent from a spec are
+        # gathered/replicated), the auto axes just lose their GSPMD
+        # partitioning inside the body on legacy jax.
+        return legacy_shard_map(f, mesh, in_specs=in_specs,
+                                out_specs=out_specs, **kw)
+
+    return shard_map
+
+
+def ensure() -> None:
+    """Idempotently install missing jax attributes (``jax.shard_map``,
+    ``jax.lax.axis_size``, ``jax.ffi``). Called from
+    ``paddle_tpu.__init__`` so any import of the package guarantees the
+    shimmed surface."""
+    global _installed
+    if _installed:
+        return
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+        jax.shard_map = _adapt_shard_map(_legacy)
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of the python int 1 constant-folds to the static axis
+        # size inside shard_map/pmap traces on legacy jax
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+    try:
+        jax.ffi
+    except AttributeError:
+        # pre-promotion spelling: jax.extend.ffi carries the same
+        # surface (ffi_call / register_ffi_target / pycapsule /
+        # include_dir) that utils/cpp_extension.py uses
+        from jax.extend import ffi as _ffi
+        jax.ffi = _ffi
+    _installed = True

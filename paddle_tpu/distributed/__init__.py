@@ -33,6 +33,9 @@ from . import reshard
 from .fault_tolerance import CheckpointManager, PreemptionHandler
 from .reshard import restore_resharded
 from . import pipeline
+from . import overlap
+from .plan import (Plan, PlanError, PlanCompilationError,
+                   PlanVerificationError)
 from . import rpc
 from . import auto_parallel
 from .launch_utils import spawn
@@ -59,6 +62,8 @@ __all__ = [
     "recompute", "recompute_sequential", "pipeline", "rpc", "auto_parallel",
     "fault_tolerance", "CheckpointManager", "PreemptionHandler",
     "reshard", "restore_resharded",
+    "overlap", "Plan", "PlanError", "PlanCompilationError",
+    "PlanVerificationError",
 ]
 
 

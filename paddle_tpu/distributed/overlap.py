@@ -1,0 +1,220 @@
+"""Compute/communication overlap primitives.
+
+Two latency-hiding mechanisms plus the static schedule model that
+quantifies them:
+
+1. **Per-layer gradient reduction in the backward pass**
+   (``reduce_in_backward``): a custom_vjp identity whose transpose is a
+   ``lax.psum``. Applied to each stacked-layer parameter slice inside
+   ``run_layer_stack``'s scan body, it makes the transposed scan emit one
+   gradient all-reduce *per layer, inside the backward loop* — layer L's
+   reduction rides under layer L-1's backward matmuls — instead of the
+   single fused tail all-reduce GSPMD schedules after the whole backward
+   finishes. ``bucketed_psum`` plays the same role for the non-stacked
+   tail parameters (embedding / norm / lm_head): several size-bounded
+   collectives that can interleave with compute rather than one fused
+   blob.
+
+2. **Double-buffered pipeline p2p** (used by
+   ``pipeline.pipeline_1f1b_value_and_grad(..., overlap=True)``): stage
+   handoffs are issued a full tick ahead of the consuming compute, so
+   within any tick the ppermute has no data dependence on that tick's
+   forward/backward units and XLA's latency-hiding scheduler can overlap
+   the ICI transfer with the matmuls. The schedule arithmetic lives here
+   (``F_TICK``/``B_TICK``/``schedule_constants``) so the simulator below
+   and the real scan body share one source of truth.
+
+3. **Schedule simulator** (``schedule_events`` /
+   ``transfer_stats`` / ``overlap_fraction``): a static, pure-Python
+   event log of either schedule. Real async timing is not observable on
+   the CPU backend, so the bench's ``overlap_fraction`` and the
+   "serialized transfer→compute ticks" regression oracle both come from
+   this model: a transfer whose consumer runs on the very next tick is
+   *serialized* (it sits on the critical path between two compute
+   ticks); a transfer with a full tick of slack is *overlapped*.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+__all__ = ["reduce_in_backward", "reduce_tree_in_backward", "bucketed_psum",
+           "schedule_constants", "schedule_events", "transfer_stats",
+           "overlap_fraction"]
+
+
+# ---------------------------------------------------------------------------
+# 1. async-dispatched gradient reduction
+# ---------------------------------------------------------------------------
+
+def _make_reduce_in_backward():
+    import jax
+    from jax import lax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def reduce_in_backward(x, axis_name):
+        return x
+
+    def _fwd(x, axis_name):
+        return x, None
+
+    def _bwd(axis_name, _res, g):
+        return (lax.psum(g, axis_name),)
+
+    reduce_in_backward.defvjp(_fwd, _bwd)
+    return reduce_in_backward
+
+
+_RIB = None
+
+
+def reduce_in_backward(x, axis_name: str):
+    """Identity in the forward pass; ``lax.psum(grad, axis_name)`` in the
+    backward pass. Hooked onto a parameter *use site* inside a scanned
+    layer body, the transpose emits the gradient all-reduce inside the
+    backward scan — per-layer, overlapped with the remaining backward
+    compute — rather than as one fused tail collective."""
+    global _RIB
+    if _RIB is None:
+        _RIB = _make_reduce_in_backward()
+    return _RIB(x, axis_name)
+
+
+def reduce_tree_in_backward(tree, axis_name: str):
+    """``reduce_in_backward`` applied to every leaf of a pytree."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a: reduce_in_backward(a, axis_name), tree)
+
+
+def bucketed_psum(tree, axis_name: str, bucket_bytes: int = 4 << 20):
+    """psum a pytree in size-bounded buckets: each bucket is one fused
+    all-reduce, and separate buckets leave the compiler free to start
+    reducing early buckets while later values are still being produced
+    (fleet's DP gradient-bucketing, minus the streams). Leaf order is
+    preserved."""
+    import jax
+    import numpy as np
+    from jax import lax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    buckets: List[List[int]] = [[]]
+    acc = 0
+    for i, leaf in enumerate(leaves):
+        nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize \
+            if getattr(leaf, "shape", None) else leaf.dtype.itemsize
+        if buckets[-1] and acc + nbytes > bucket_bytes:
+            buckets.append([])
+            acc = 0
+        buckets[-1].append(i)
+        acc += nbytes
+    out = list(leaves)
+    for idxs in buckets:
+        reduced = lax.psum(tuple(leaves[i] for i in idxs), axis_name)
+        for i, r in zip(idxs, reduced):
+            out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# 2/3. 1F1B schedule arithmetic + static event model
+# ---------------------------------------------------------------------------
+
+def F_TICK(stage: int, micro: int, *, overlap: bool) -> int:
+    """Tick at which stage ``stage`` runs the forward of microbatch
+    ``micro``. Lockstep: s + m (handoffs consumed on the very next
+    tick). Overlapped: 2s + m — one extra tick of pipeline depth per
+    stage buys every edge transfer a full tick of slack."""
+    return (2 * stage if overlap else stage) + micro
+
+
+def B_TICK(stage: int, micro: int, pp: int, *, overlap: bool) -> int:
+    """Tick of the backward unit B(stage, micro). Lockstep:
+    2*pp - 1 - s + m. Overlapped: 4*(pp-1) + 1 - 2s + m (the last
+    stage's backward still starts one tick after its forward)."""
+    if overlap:
+        return 4 * (pp - 1) + 1 - 2 * stage + micro
+    return 2 * pp - 1 - stage + micro
+
+
+def schedule_constants(pp: int, n_micro: int, *,
+                       overlap: bool) -> Dict[str, int]:
+    """(T, BUF) for the scan: total ticks and the stage-input ring-buffer
+    depth. These are the same expressions the shard_map scan in
+    ``pipeline.pipeline_1f1b_value_and_grad`` uses — the simulator and
+    the kernel cannot drift apart."""
+    if overlap:
+        # last backward: B(0, n_micro-1) at 4*(pp-1)+1 + n_micro-1
+        return {"T": n_micro + 4 * pp - 3, "BUF": 4 * pp}
+    return {"T": n_micro + 2 * pp - 1, "BUF": 2 * pp}
+
+
+def schedule_events(pp: int, n_micro: int, *, overlap: bool,
+                    log: Optional[list] = None) -> List[Dict[str, Any]]:
+    """Static event log of one 1F1B batch.
+
+    Events (dicts) come in two kinds:
+      compute  — {"kind": "fwd"|"bwd", "tick", "stage", "micro"}
+      transfer — {"kind": "send_fwd"|"send_bwd", "tick", "src", "dst",
+                  "micro", "produced_tick", "consumed_tick"}
+
+    ``log`` is injectable: callers (tests) pass their own list and the
+    function appends into it, so schedule-ordering assertions run
+    against exactly what the model emitted. Returns the log either way.
+    """
+    if pp < 1 or n_micro < 1:
+        raise ValueError(f"need pp >= 1 and n_micro >= 1, "
+                         f"got pp={pp}, n_micro={n_micro}")
+    events = log if log is not None else []
+    for m in range(n_micro):
+        for s in range(pp):
+            tf = F_TICK(s, m, overlap=overlap)
+            tb = B_TICK(s, m, pp, overlap=overlap)
+            events.append({"kind": "fwd", "tick": tf, "stage": s,
+                           "micro": m})
+            events.append({"kind": "bwd", "tick": tb, "stage": s,
+                           "micro": m})
+            if s < pp - 1:
+                # forward edge s -> s+1: consumed at F(s+1, m)
+                consumed = F_TICK(s + 1, m, overlap=overlap)
+                events.append({
+                    "kind": "send_fwd", "micro": m, "src": s, "dst": s + 1,
+                    "tick": tf + 1 if overlap else tf,
+                    "produced_tick": tf, "consumed_tick": consumed})
+            if s > 0:
+                # backward edge s -> s-1: consumed at B(s-1, m)
+                consumed = B_TICK(s - 1, m, pp, overlap=overlap)
+                events.append({
+                    "kind": "send_bwd", "micro": m, "src": s, "dst": s - 1,
+                    "tick": tb + 1 if overlap else tb,
+                    "produced_tick": tb, "consumed_tick": consumed})
+    events.sort(key=lambda e: (e["tick"], e["stage"] if "stage" in e
+                               else e["src"]))
+    return events
+
+
+def transfer_stats(events) -> Dict[str, int]:
+    """Count stage-boundary transfers and how many are *serialized*: the
+    consuming compute runs on the tick right after the producing compute,
+    so the wire sits on the critical path (compute -> transfer ->
+    compute with zero slack). A transfer with >= 2 ticks between
+    producer and consumer has a full tick to ride under compute."""
+    total = serialized = 0
+    for e in events:
+        if e["kind"] not in ("send_fwd", "send_bwd"):
+            continue
+        total += 1
+        if e["consumed_tick"] - e["produced_tick"] < 2:
+            serialized += 1
+    return {"total_transfers": total, "serialized_transfers": serialized}
+
+
+def overlap_fraction(events) -> float:
+    """Fraction of stage-boundary transfers hidden under compute (1.0 =
+    every edge has a free tick; 0.0 = every edge serializes a tick)."""
+    st = transfer_stats(events)
+    if st["total_transfers"] == 0:
+        return 1.0
+    return 1.0 - st["serialized_transfers"] / st["total_transfers"]

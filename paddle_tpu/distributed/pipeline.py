@@ -134,35 +134,52 @@ def pipeline_loss_fn(cfg, mesh, n_micro, params, batch, cp_axis=None):
 # 1F1B schedule
 # ---------------------------------------------------------------------------
 
-def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
+def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch,
+                                 overlap=False):
     """Hand-scheduled 1F1B: returns (loss, ce, grads) directly.
 
     Reference analog: pipeline_parallel.py:228 (_forward_backward_pipeline
     — warmup forwards, steady 1F1B, cooldown backwards, capping in-flight
     activations at O(pp) instead of GPipe's O(n_micro)).
 
-    TPU-native: one lax.scan of T = n_micro + 2*pp - 1 ticks inside
-    shard_map. Per tick every stage runs one forward unit (activation
-    handed to the next stage by ppermute) and one backward unit (gradient
-    handed to the previous stage by the reverse ppermute). The backward
-    unit re-derives its vjp from a ring buffer of saved *stage inputs*
-    (size 2*pp, the 1F1B residency bound: micro m is live on stage s for
-    2*(pp-s)-1 ticks) — activation recomputation, so saved state per stage
-    is 2*pp microbatch inputs regardless of n_micro, while grad-of-GPipe
-    keeps residuals for every scan step. Schedule arithmetic: F(s,m) at
-    tick s+m, B(s,m) at tick 2*pp-1-s+m; jax.grad's scan transpose is
-    replaced by explicit per-unit jax.vjp, so this function computes its
-    own grads (it is not meant to be differentiated).
+    TPU-native: one lax.scan of T ticks inside shard_map. Per tick every
+    stage runs one forward unit (activation handed to the next stage by
+    ppermute) and one backward unit (gradient handed to the previous
+    stage by the reverse ppermute). The backward unit re-derives its vjp
+    from a ring buffer of saved *stage inputs* — activation
+    recomputation, so saved state per stage is O(pp) microbatch inputs
+    regardless of n_micro, while grad-of-GPipe keeps residuals for every
+    scan step. jax.grad's scan transpose is replaced by explicit
+    per-unit jax.vjp, so this function computes its own grads (it is not
+    meant to be differentiated).
+
+    Two schedules (arithmetic shared with ``distributed.overlap`` so the
+    static simulator and this kernel cannot drift):
+
+    * ``overlap=False`` (lockstep): F(s,m) at tick s+m, B(s,m) at tick
+      2*pp-1-s+m, T = n_micro + 2*pp - 1. The ppermute at the end of
+      each tick feeds the consuming compute of the very next tick —
+      every stage-boundary transfer serializes against compute.
+    * ``overlap=True`` (double-buffered p2p): F(s,m) at tick 2s+m,
+      B(s,m) at tick 4*(pp-1)+1-2s+m, T = n_micro + 4*pp - 3. Each
+      stage keeps send/recv edge buffers in the carry and issues both
+      ppermutes at the *top* of the tick on values computed a full tick
+      earlier, so within any tick the transfers have no data dependence
+      on that tick's forward/backward units — XLA's latency-hiding
+      scheduler overlaps the ICI hop with the matmuls. The price is a
+      deeper warmup (2 ticks/stage) and a 4*pp ring buffer; per-edge
+      numerics are identical (same units, same accumulation order).
 
     The CE head runs per-microbatch inside the last stage's backward unit
     (its vjp seeds the gradient chain). The embedding lives inside the
     manual region too: stage 0 looks its microbatch up per forward unit
     (ids are int32 — tiny) and accumulates d_embed as a param-sized [V,H]
     carry per backward unit, so no O(B*S*H) activation or gradient stack
-    is ever materialized — per-stage live state really is the 2*pp ring
+    is ever materialized — per-stage live state really is the ring
     buffer plus param-sized accumulators.
     """
     from ..models.llama import _rope_tables, _rms_norm, run_layer_stack
+    from .overlap import schedule_constants
 
     ids, labels = batch["input_ids"], batch["labels"]
     B, S = ids.shape
@@ -180,8 +197,9 @@ def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
         pp = lax.axis_size("pp")
         stage = lax.axis_index("pp")
         is_last = stage == pp - 1
-        BUF = 2 * pp
-        T = n_micro + 2 * pp - 1
+        # pp is static under shard_map; T/BUF shared with the simulator
+        consts = schedule_constants(int(pp), n_micro, overlap=overlap)
+        BUF, T = consts["BUF"], consts["T"]
 
         def stage_fwd(ll, xin):
             return run_layer_stack(cfg, ll, xin, sin_, cos_)  # (y, aux)
@@ -197,23 +215,37 @@ def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
         bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
 
         def tick(carry, t):
-            (fwd_state, bwd_state, xs_buf, dlayers, dembed, dnorm, dhead,
-             ce_sum, aux_sum) = carry
+            (fwd_state, bwd_state, fwd_recv, bwd_recv, xs_buf, dlayers,
+             dembed, dnorm, dhead, ce_sum, aux_sum) = carry
 
-            # ---- forward unit: F(s, m) at t = s + m
-            fm = t - stage
+            if overlap:
+                # p2p issued FIRST, on edge values computed a full tick
+                # earlier: no data dependence on this tick's compute, so
+                # the collective-permute rides under the matmuls below.
+                # fwd_state/bwd_state hold last tick's outputs (pending
+                # send); fwd_recv/bwd_recv hold what arrived last tick
+                # (consumed this tick).
+                recv_f = lax.ppermute(fwd_state, "pp", fwd_perm)
+                recv_b = lax.ppermute(bwd_state, "pp", bwd_perm)
+                fwd_in, bwd_in = fwd_recv, bwd_recv
+                fm = t - 2 * stage
+                bm = t - (4 * (pp - 1) + 1 - 2 * stage)
+            else:
+                fwd_in, bwd_in = fwd_state, bwd_state
+                fm = t - stage                      # F(s, m) at t = s + m
+                bm = t - (2 * pp - 1 - stage)       # B(s, m)
+
+            # ---- forward unit
             do_f = (fm >= 0) & (fm < n_micro)
             fidx = jnp.clip(fm, 0, n_micro - 1)
             x_emb = jnp.take(embed_w, ids_stack[fidx], axis=0)
-            x_in = jnp.where(stage == 0, x_emb, fwd_state)
+            x_in = jnp.where(stage == 0, x_emb, fwd_in)
             y, _ = stage_fwd(layers_local, x_in)
             xs_upd = lax.dynamic_update_index_in_dim(
                 xs_buf, x_in, fm % BUF, 0)
             xs_buf = jnp.where(do_f, xs_upd, xs_buf)
-            fwd_state = lax.ppermute(y, "pp", fwd_perm)
 
-            # ---- backward unit: B(s, m) at t = 2*pp - 1 - s + m
-            bm = t - (2 * pp - 1 - stage)
+            # ---- backward unit
             do_b = (bm >= 0) & (bm < n_micro)
             bidx = jnp.clip(bm, 0, n_micro - 1)
             x_saved = xs_buf[bm % BUF]
@@ -223,7 +255,7 @@ def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
                 lambda nw, hw, yy: head_ce(nw, hw, yy, lab_stack[bidx]),
                 norm_w, head_w, y_b)
             dnorm_m, dhead_m, g_last = head_vjp(jnp.float32(inv_nm))
-            g_in = jnp.where(is_last, g_last, bwd_state)
+            g_in = jnp.where(is_last, g_last, bwd_in)
             dlayers_m, dx_m = stage_vjp(
                 (g_in, jnp.asarray(0.01 * inv_nm, aux_b.dtype)))
 
@@ -241,20 +273,29 @@ def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch):
             demb_m = jnp.zeros_like(dembed).at[ids_stack[bidx]].add(
                 dx_m.astype(dembed.dtype))
             dembed = dembed + jnp.where(mask_b & (stage == 0), demb_m, 0)
-            bwd_state = lax.ppermute(dx_m, "pp", bwd_perm)
 
-            return (fwd_state, bwd_state, xs_buf, dlayers, dembed, dnorm,
-                    dhead, ce_sum, aux_sum), None
+            if overlap:
+                # this tick's outputs become next tick's sends; this
+                # tick's arrivals are consumed the tick after
+                fwd_state, fwd_recv = y, recv_f
+                bwd_state, bwd_recv = dx_m, recv_b
+            else:
+                fwd_state = lax.ppermute(y, "pp", fwd_perm)
+                bwd_state = lax.ppermute(dx_m, "pp", bwd_perm)
+
+            return (fwd_state, bwd_state, fwd_recv, bwd_recv, xs_buf,
+                    dlayers, dembed, dnorm, dhead, ce_sum, aux_sum), None
 
         z = jnp.zeros((mb, S, H), embed_w.dtype)
         carry0 = (
-            z, z, jnp.zeros((BUF, mb, S, H), embed_w.dtype),
+            z, z, z, z, jnp.zeros((BUF, mb, S, H), embed_w.dtype),
             jax.tree_util.tree_map(jnp.zeros_like, layers_local),
             jnp.zeros_like(embed_w),
             jnp.zeros_like(norm_w), jnp.zeros_like(head_w),
             jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
-        (fwd_state, bwd_state, xs_buf, dlayers, dembed, dnorm, dhead,
-         ce_sum, aux_sum), _ = lax.scan(tick, carry0, jnp.arange(T))
+        (fwd_state, bwd_state, fwd_recv, bwd_recv, xs_buf, dlayers,
+         dembed, dnorm, dhead, ce_sum, aux_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
 
         # head/embed grads and the scalars live on one stage; psum
         # replicates them so out_specs can be P()

@@ -37,6 +37,9 @@ def _pack(obj):
         return arr
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        # NamedTuple (e.g. optax optimizer states): positional ctor
+        return type(obj)(*(_pack(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(_pack(v) for v in obj)
     return obj
@@ -51,6 +54,8 @@ def _unpack(obj):
         return {k: _unpack(v) for k, v in obj.items()}
     if isinstance(obj, np.ndarray):
         return Tensor(jnp.asarray(obj))
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*(_unpack(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(_unpack(v) for v in obj)
     return obj

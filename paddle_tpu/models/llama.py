@@ -360,15 +360,25 @@ def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
 
 
 def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos,
-                    cp_mesh=None, cp_axis="sp", cp_axis_level=False):
+                    cp_mesh=None, cp_axis="sp", cp_axis_level=False,
+                    grad_sync_axis=None):
     """lax.scan over the stacked layer axis (compiler-friendly sequential
-    control flow; remat per layer = the recompute strategy)."""
+    control flow; remat per layer = the recompute strategy).
+
+    grad_sync_axis: when set (manual shard_map data parallelism), each
+    layer's parameter slice is routed through ``reduce_in_backward`` so
+    the transposed scan emits one gradient all-reduce per layer *inside*
+    the backward loop — overlapped with the remaining backward compute —
+    instead of a single fused tail collective."""
     layer_fn = functools.partial(decoder_layer, cp_axis_level=cp_axis_level,
                                  cp_mesh=cp_mesh,
                                  cp_axis=cp_axis)
 
     def body(carry, lp):
         h, aux = carry
+        if grad_sync_axis is not None:
+            from ..distributed.overlap import reduce_tree_in_backward
+            lp = reduce_tree_in_backward(lp, grad_sync_axis)
         fn = layer_fn
         if cfg.use_remat:
             policy = None  # "full": save nothing, recompute the layer
@@ -383,7 +393,7 @@ def run_layer_stack(cfg: LlamaConfig, stacked, x, sin, cos,
 
 
 def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None,
-                 cp_mesh=None, cp_axis="sp"):
+                 cp_mesh=None, cp_axis="sp", grad_sync_axis=None):
     """Full forward: ids -> logits (fp32). sp_axis: mesh axis name to shard
     the sequence dimension of activations on (Megatron-style sequence
     parallelism for the elementwise/norm work). cp_mesh: enable ring-
@@ -401,17 +411,19 @@ def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None,
     elif sp_axis is not None:
         x = lax.with_sharding_constraint(x, P("dp", sp_axis, None))
     x, aux = run_layer_stack(cfg, params["layers"], x, sin, cos,
-                             cp_mesh=cp_mesh, cp_axis=cp_axis)
+                             cp_mesh=cp_mesh, cp_axis=cp_axis,
+                             grad_sync_axis=grad_sync_axis)
     x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, aux
 
 
 def loss_fn(cfg: LlamaConfig, params, batch, sp_axis=None,
-            cp_mesh=None, cp_axis="sp"):
+            cp_mesh=None, cp_axis="sp", grad_sync_axis=None):
     ids, labels = batch["input_ids"], batch["labels"]
     logits, aux = forward_pure(cfg, params, ids, sp_axis, cp_mesh=cp_mesh,
-                               cp_axis=cp_axis)
+                               cp_axis=cp_axis,
+                               grad_sync_axis=grad_sync_axis)
     # logsumexp form: ce = lse - target_logit. Avoids materializing the
     # full [B, S, V] log-softmax (1 GB fp32 at bench shapes) — XLA fuses
     # the reduction into the lm_head matmul epilogue.
@@ -592,7 +604,7 @@ def generate(cfg: LlamaConfig, params, input_ids, max_new_tokens,
 
 def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
                      n_microbatches=None, zero=True, schedule="gpipe",
-                     virtual_pp=None):
+                     virtual_pp=None, overlap=False):
     """Compiled full training step over the hybrid mesh.
 
     Returns (step_fn, init_fn):
@@ -604,6 +616,14 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
     pp_degree > 1. schedule: "gpipe" (autodiff-transposed scan) or "1f1b"
     (hand-scheduled forward/backward interleave, O(pp) activation
     residency — reference pipeline_parallel.py:228).
+
+    overlap: enable compute/communication overlap. With schedule='1f1b'
+    the pipeline issues stage-boundary ppermutes one tick ahead of the
+    consuming compute (double-buffered edge activations). On a pure-DP
+    topology the gradient all-reduce is split into per-layer psums
+    emitted inside the backward scan (``reduce_in_backward``) plus
+    bucketed collectives for the tail params, instead of one fused tail
+    all-reduce. Other topologies ignore the flag.
     """
     import optax
     if schedule not in ("gpipe", "1f1b", "interleaved"):
@@ -632,7 +652,8 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
 
         def grad_fn(params, batch):
             total, ce, grads = pipeline_1f1b_value_and_grad(
-                cfg, mesh, n_microbatches or pp, params, batch)
+                cfg, mesh, n_microbatches or pp, params, batch,
+                overlap=overlap)
             return (total, ce), grads
     elif use_pp and schedule == "interleaved":
         from ..distributed.pipeline import pipeline_interleaved_loss_fn
@@ -649,11 +670,50 @@ def build_train_step(cfg: LlamaConfig, topo, optimizer=None, use_pp=None,
                                  cp_axis="sp" if cp_in_pp else None)
     else:
         cp_mesh = mesh if getattr(topo, "sp_degree", 1) > 1 else None
+        dp_deg = topo.dims.get("dp", 1)
+        # pure-DP overlap: manual shard_map over 'dp' with per-layer
+        # backward-scan gradient psums + bucketed tail collectives. Only
+        # sound when no other axis carries model state (params fully
+        # replicated across 'dp').
+        overlap_dp = (overlap and cp_mesh is None and dp_deg > 1
+                      and topo.dims.get("mp", 1) == 1
+                      and topo.dims.get("sharding", 1) == 1
+                      and cfg.moe_num_experts == 0)
+        if overlap_dp:
+            from ..distributed.overlap import bucketed_psum
 
-        def loss(params, batch):
-            if cp_mesh is not None:  # ring-attention context parallel
-                return loss_fn(cfg, params, batch, cp_mesh=cp_mesh)
-            return loss_fn(cfg, params, batch, sp_axis="mp")
+            def _dp_body(params, batch):
+                def local_loss(p):
+                    # local mean loss scaled by 1/dp: psum of its grads
+                    # over 'dp' is exactly the global-batch gradient
+                    t, c = loss_fn(cfg, p, batch, grad_sync_axis="dp")
+                    return t / dp_deg, (t, c)
+                (_, (t, c)), grads = jax.value_and_grad(
+                    local_loss, has_aux=True)(params)
+                # layer grads were psum'd per layer inside the backward
+                # scan; the non-stacked tail reduces in byte-bounded
+                # buckets so early buckets overlap late backward compute
+                tail = bucketed_psum(
+                    {k: v for k, v in grads.items() if k != "layers"},
+                    "dp")
+                grads = dict(grads, **tail)
+                return lax.pmean(t, "dp"), lax.pmean(c, "dp"), grads
+
+            def grad_fn(params, batch):
+                param_p = jax.tree_util.tree_map(lambda _: P(), params)
+                total, ce, grads = jax.shard_map(
+                    _dp_body, mesh=mesh,
+                    in_specs=(param_p,
+                              {"input_ids": P("dp", None),
+                               "labels": P("dp", None)}),
+                    out_specs=(P(), P(), param_p),
+                    axis_names={"dp"}, check_vma=False)(params, batch)
+                return (total, ce), grads
+        else:
+            def loss(params, batch):
+                if cp_mesh is not None:  # ring-attention context parallel
+                    return loss_fn(cfg, params, batch, cp_mesh=cp_mesh)
+                return loss_fn(cfg, params, batch, sp_axis="mp")
 
     from ._sharding_utils import sharding_tree
     param_sh = sharding_tree(mesh, specs)

@@ -112,6 +112,13 @@ def test_context_parallel_train_step_matches_dense():
     # and the full train step runs with cp enabled via build_train_step
     step_fn, init_fn = build_train_step(cfg, topo, use_pp=False)
     p2, opt_state = init_fn(jax.random.PRNGKey(0))
+    # jit with sharded out_shardings draws different threefry bits than
+    # the eager init on this jax version; the parity check needs the
+    # SAME weights as the dense reference, so place those into the
+    # step's layout
+    p2 = jax.tree_util.tree_map(
+        lambda ref, x: jax.device_put(np.asarray(x), ref.sharding),
+        p2, params)
     sh = NamedSharding(topo.mesh, P("dp", None))
     placed = {k: jax.device_put(v, sh) for k, v in batch.items()}
     p2, opt_state, m = step_fn(p2, opt_state, placed)

@@ -82,6 +82,13 @@ def _parse_args(argv=None):
                     help="override the planner: dp,pp,sharding,mp")
     ap.add_argument("--out", default="-",
                     help="output path for the JSON report (- = stdout)")
+    ap.add_argument("--plan-out", default=None,
+                    help="also write the winning topology as an "
+                         "executable plan spec (distributed.plan.Plan "
+                         "JSON: axes, schedule, microbatches, "
+                         "per-param partition specs) — "
+                         "Plan.from_report() / Plan.load() compile "
+                         "exactly the config the planner scored")
     ap.add_argument("--list-presets", action="store_true")
     return ap.parse_args(argv)
 
@@ -428,6 +435,37 @@ def _plan_notes(n_dev):
     return notes
 
 
+def write_plan_spec(report, preset, path):
+    """Serialize the report's winning topology as an executable
+    ``distributed.plan.Plan`` spec: axes + schedule/microbatches from the
+    report's ``topology`` section, plus the model's per-parameter
+    partition specs in the portable ``reshard.spec_to_json`` form (keyed
+    by '/'-joined parameter path). ``Plan.load(path)`` /
+    ``Plan.from_report(path)`` then compile exactly the config the
+    planner scored."""
+    import dataclasses
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.plan import Plan
+    from paddle_tpu.distributed.reshard import spec_to_json
+    from paddle_tpu.models import llama
+
+    cfg = llama.preset(preset)
+    plan = Plan.from_report(report)
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        llama.param_specs(cfg), is_leaf=lambda s: isinstance(s, P))
+
+    def key(p):
+        return "/".join(str(getattr(k, "key", k)) for k in p)
+
+    plan = dataclasses.replace(
+        plan, param_specs={key(p): spec_to_json(s) for p, s in flat})
+    plan.save(path)
+    print(f"wrote plan spec {path}", file=sys.stderr)
+
+
 def main(argv=None):
     args = _parse_args(argv)
     _, n_dev = parse_mesh(args.mesh)
@@ -446,6 +484,8 @@ def main(argv=None):
         return 0
 
     report = build_report(args)
+    if args.plan_out:
+        write_plan_spec(report, args.preset, args.plan_out)
     payload = json.dumps(report, indent=2, sort_keys=False)
     if args.out == "-":
         print(payload)
