@@ -286,7 +286,7 @@ def main():
     return result
 
 
-def multichip_main(n_devices=8):
+def multichip_main(n_devices=8, trace_out=None):
     """--multichip preset: the Plan compile path on ``n_devices`` virtual
     host-platform devices (dp=2 x pp=2 x mp=2), 1F1B with double-buffered
     p2p (overlap=True) against the lockstep scan on the same config.
@@ -297,20 +297,28 @@ def multichip_main(n_devices=8):
     ``overlap_fraction`` (fraction of stage-boundary transfers with a
     full tick of slack to ride under compute — real async timing is not
     observable on the CPU backend, so the number comes from the shared
-    schedule model in ``distributed.overlap``)."""
+    schedule model in ``distributed.overlap``). With ``trace_out`` the
+    flight recorder is enabled: train/step spans plus the recorded
+    pipeline schedule land in a rank-tagged JSONL sidecar there, the
+    measured overlap fraction (scored from the *recorded* schedule) is
+    reported next to the static one, and the sidecar path rides in the
+    JSON line for ``tools/trace_report.py``."""
     jax.config.update("jax_platforms", "cpu")
     import _xla_cpu_flags
     _xla_cpu_flags.ensure(device_count=n_devices)
 
     import optax
     from paddle_tpu.core.flags import set_flags
-    from paddle_tpu.distributed.overlap import (overlap_fraction,
+    from paddle_tpu.distributed.overlap import (measured_overlap,
+                                                overlap_fraction,
                                                 schedule_events,
                                                 transfer_stats)
     from paddle_tpu.distributed.plan import Plan
     from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.profiler import trace as _trace
 
-    set_flags({"FLAGS_tpu_metrics": True})
+    set_flags({"FLAGS_tpu_metrics": True,
+               "FLAGS_tpu_trace": trace_out is not None})
     _enable_compile_cache()
     devices = jax.devices()
     _log(f"{len(devices)} virtual devices ready")
@@ -350,6 +358,7 @@ def multichip_main(n_devices=8):
 
     _log("measuring overlapped 1F1B Plan path")
     overlap_ms, loss_o = measure(True)
+    evs_after_overlap = _trace.events() if trace_out else []
     _log("measuring lockstep 1F1B scan")
     lockstep_ms, loss_l = measure(False)
 
@@ -357,6 +366,28 @@ def multichip_main(n_devices=8):
     ev_o = schedule_events(pp, n_micro, overlap=True)
     ev_l = schedule_events(pp, n_micro, overlap=False)
     st_o, st_l = transfer_stats(ev_o), transfer_stats(ev_l)
+
+    # measured schedule: scored from what the flight recorder saw the
+    # executed plans emit — must match the static model bit-for-bit
+    measured = None
+    trace_sidecar = None
+    if trace_out:
+        all_evs = _trace.events()
+        meas_o = _trace.pipeline_schedule_events(evs_after_overlap)
+        meas_l = _trace.pipeline_schedule_events(
+            all_evs[len(evs_after_overlap):])
+        measured = {
+            "overlap_fraction": round(
+                measured_overlap(meas_o)["overlap_fraction"], 3),
+            "overlap_fraction_lockstep": round(
+                measured_overlap(meas_l)["overlap_fraction"], 3),
+            "matches_static": meas_o == ev_o and meas_l == ev_l,
+        }
+        os.makedirs(trace_out, exist_ok=True)
+        trace_sidecar = _trace.write_sidecar(
+            _trace.sidecar_path(trace_out),
+            extra={"bench": "multichip", "devices": len(devices)})
+        _log(f"trace sidecar: {trace_sidecar}")
 
     # modeled per-step collective traffic on this plan
     itemsize = 4  # fp32
@@ -409,29 +440,44 @@ def multichip_main(n_devices=8):
             "collective_metrics": coll,
         },
     }
+    if measured is not None:
+        result["detail"]["overlap"]["measured"] = measured
+        result["detail"]["trace_sidecar"] = trace_sidecar
     assert st_o["serialized_transfers"] < st_l["serialized_transfers"], \
         "overlap schedule must serialize strictly fewer transfers"
     return result
 
 
-def run_multichip(n_devices=8):
+def run_multichip(n_devices=8, trace_out=None):
     """--multichip run harness: same never-exit-silent contract as
     run(), on the virtual-pod Plan path."""
     from paddle_tpu.runtime.watchdog import (PhaseTimeout,
+                                             persist_incidents,
                                              run_with_deadline)
     timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1000"))
     try:
         result = run_with_deadline(
-            lambda: multichip_main(n_devices), timeout_s, phase="measure")
+            lambda: multichip_main(n_devices, trace_out=trace_out),
+            timeout_s, phase="measure")
     except PhaseTimeout:
         print(json.dumps(_error_result(
             f"multichip bench timed out after {timeout_s:.0f}s")))
         sys.stdout.flush()
+        _persist_incidents_quietly(persist_incidents)
         os._exit(0)
     except BaseException as e:  # noqa: BLE001 — the line must print
         result = _error_result(str(e) or repr(e))
     print(json.dumps(result))
     return 0
+
+
+def _persist_incidents_quietly(persist_fn):
+    """Flush the incident buffer before an os._exit path (which skips
+    atexit) — the post-mortem sidecar must land even on a hang exit."""
+    try:
+        persist_fn()
+    except OSError as e:
+        _log(f"incident persist failed: {e}")
 
 
 def _init_device_with_retries(probe_fn, window_s=240.0, base_delay=5.0,
@@ -490,6 +536,7 @@ def run():
     instead of burning the whole budget (round 3's 0.0). Stage 2: the
     full measurement must land within PADDLE_TPU_BENCH_TIMEOUT."""
     from paddle_tpu.runtime.watchdog import (PhaseTimeout,
+                                             persist_incidents,
                                              run_with_deadline)
     from paddle_tpu.testing.chaos import chaos_point
 
@@ -514,6 +561,7 @@ def run():
             f"({attempts} attempt(s); TPU tunnel down or unclaimable): "
             f"{err}")))
         sys.stdout.flush()
+        _persist_incidents_quietly(persist_incidents)
         os._exit(0)  # a hung init thread would block a clean exit
 
     try:
@@ -523,6 +571,7 @@ def run():
             f"bench timed out after {timeout_s:.0f}s "
             "(compile or execute hang)")))
         sys.stdout.flush()
+        _persist_incidents_quietly(persist_incidents)
         os._exit(0)  # the hung measure thread would block a clean exit
     except BaseException as e:  # noqa: BLE001 — the line must print
         result = _error_result(str(e) or repr(e))
@@ -539,5 +588,11 @@ if __name__ == "__main__":
                          "instead of the 1-chip MFU bench")
     ap.add_argument("--devices", type=int, default=8,
                     help="virtual device count for --multichip")
+    ap.add_argument("--trace-out", default=None, metavar="DIR",
+                    help="enable the flight recorder and write the "
+                         "rank-tagged trace sidecar into DIR "
+                         "(--multichip only; read it with "
+                         "tools/trace_report.py)")
     cli = ap.parse_args()
-    sys.exit(run_multichip(cli.devices) if cli.multichip else run())
+    sys.exit(run_multichip(cli.devices, trace_out=cli.trace_out)
+             if cli.multichip else run())

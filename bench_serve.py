@@ -34,6 +34,14 @@ The JSON line carries a ``resilience`` block (shed / recoveries /
 quarantined / deadline-expired counts for the measured run, plus the
 observed-vs-target SLO verdicts) so overload and chaos E2E runs are
 assertable from the one-line contract.
+
+``--trace-out DIR`` (or _TRACE_OUT) turns on the flight recorder for
+the measured run: every request's lifecycle events (queued -> admitted
+-> prefill -> first token -> decode -> terminal) land in a rank-tagged
+JSONL sidecar under DIR, the SLO block gains the TTFT breakdown
+(queue/prefill/decode p95), and the sidecar path rides in the JSON
+line — feed it to ``tools/trace_report.py`` for per-request timelines
+whose breakdown sums exactly to the measured TTFT.
 """
 from __future__ import annotations
 
@@ -96,6 +104,12 @@ def main():
     if workload not in ("uniform", "shared-prefix"):
         raise ValueError(f"unknown --workload {workload!r} "
                          "(uniform | shared-prefix)")
+    trace_out = os.environ.get("PADDLE_TPU_BENCH_SERVE_TRACE_OUT")
+    if "--trace-out" in sys.argv:
+        trace_out = sys.argv[sys.argv.index("--trace-out") + 1]
+    from paddle_tpu.profiler import trace as _trace
+    if trace_out:
+        _flags.set_flags({"FLAGS_tpu_trace": True})
     shared = workload == "shared-prefix"
     n_req = _env_int("REQUESTS", 16)
     max_prompt = _env_int("PROMPT", 24)
@@ -176,6 +190,11 @@ def main():
     _m.reset()
     eng._ttft_s.clear()
     eng._latency_s.clear()
+    eng._queue_s.clear()
+    eng._prefill_s.clear()
+    eng._decode_s.clear()
+    if trace_out:
+        _trace.clear()  # measured-run lifecycle events only
     # the module stats dict is cumulative across the process — the
     # resilience block reports measured-run deltas from this snapshot
     base = serving.serving_stats()
@@ -263,6 +282,24 @@ def main():
         "latency_slo_ms": _ms(rep["latency_slo_s"]),
         "latency_ok": rep["latency_ok"],
     }
+    bd = rep.get("breakdown")
+    if bd:
+        res["slo"]["ttft_breakdown_ms"] = {
+            "queue_p95": _ms(bd["queue_p95_s"]),
+            "prefill_p95": _ms(bd["prefill_p95_s"]),
+            "decode_p95": _ms(bd["decode_p95_s"]),
+            "samples": bd["samples"],
+        }
+
+    trace_sidecar = None
+    if trace_out:
+        os.makedirs(trace_out, exist_ok=True)
+        trace_sidecar = _trace.write_sidecar(
+            _trace.sidecar_path(trace_out),
+            extra={"bench": "serve", "workload": workload,
+                   "requests": len(rids)})
+        _log(f"trace sidecar: {trace_sidecar} (read with "
+             "tools/trace_report.py)")
 
     result = {
         "metric": "serve_tokens_per_sec_chip",
@@ -292,6 +329,8 @@ def main():
         "device": getattr(dev, "device_kind", dev.platform),
         "chips": n_chips,
     }
+    if trace_sidecar is not None:
+        result["trace_sidecar"] = trace_sidecar
     try:
         with open(_LAST_FILE, "w") as f:
             json.dump(result, f)
@@ -328,6 +367,7 @@ def run():
     bench.py): failures and hangs print value 0.0 with the error and
     the runtime health layer's incident record attached."""
     from paddle_tpu.runtime.watchdog import (PhaseTimeout,
+                                             persist_incidents,
                                              run_with_deadline)
 
     timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "900"))
@@ -338,6 +378,11 @@ def run():
             f"bench_serve timed out after {timeout_s:.0f}s "
             "(compile or execute hang)")))
         sys.stdout.flush()
+        try:
+            # os._exit skips atexit — flush the incident sidecar now
+            persist_incidents()
+        except OSError as e:
+            _log(f"incident persist failed: {e}")
         os._exit(0)  # the hung measure thread would block a clean exit
     except BaseException as e:  # noqa: BLE001 — the line must print
         result = _error_result(str(e) or repr(e))

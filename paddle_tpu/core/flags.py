@@ -121,6 +121,12 @@ define_flag("FLAGS_tpu_watchdog_serve_step", 120.0,
             "step past the deadline is treated as a hung device call "
             "and converted into the engine's pool-rebuild replay "
             "recovery. <=0 disables.")
+define_flag("FLAGS_tpu_trace", False,
+            "Structured event/span tracing (profiler.trace flight "
+            "recorder): ring-buffered request-lifecycle, train-step, "
+            "pipeline-schedule, and collective events with rank-tagged "
+            "JSONL sidecars for tools/trace_report.py. Off: every "
+            "recording call is a dict lookup + bool check.")
 define_flag("FLAGS_tpu_xmem", False,
             "Capture per-executable memory_analysis()/cost_analysis() "
             "(HBM peaks, temp bytes, flops) at every jit/Executor/"

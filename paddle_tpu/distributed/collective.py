@@ -153,11 +153,16 @@ def _apply_collective(f, tensor, op_name):
     un-instrumented path costs one list truthiness check, one
     dict-lookup+bool (metrics.enabled), and two module-global None
     checks (health hook, chaos hook)."""
-    from ..profiler import _record_span, metrics as _metrics
+    from ..profiler import _record_span, metrics as _metrics, \
+        trace as _trace
     from ..runtime import health as _health
     rec = _metrics.enabled()
     t0 = time.perf_counter() if rec else None
-    with _record_span(f"collective/{op_name}"):
+    span_name = f"collective/{op_name}"
+    # the health beacon promoted to a first-class trace span: when
+    # FLAGS_tpu_trace is on, every collective entry/exit lands in the
+    # flight recorder with its duration (disabled: one dict lookup)
+    with _record_span(span_name), _trace.span(span_name, op=op_name):
         # beacon outermost: the chaos hang below must count as "inside
         # the collective" so self-detection sees the overdue beacon
         with _health.collective_beacon(op_name):

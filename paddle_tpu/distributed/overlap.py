@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional
 
 __all__ = ["reduce_in_backward", "reduce_tree_in_backward", "bucketed_psum",
            "schedule_constants", "schedule_events", "transfer_stats",
-           "overlap_fraction"]
+           "overlap_fraction", "measured_overlap"]
 
 
 # ---------------------------------------------------------------------------
@@ -218,3 +218,16 @@ def overlap_fraction(events) -> float:
     if st["total_transfers"] == 0:
         return 1.0
     return 1.0 - st["serialized_transfers"] / st["total_transfers"]
+
+
+def measured_overlap(events) -> Dict[str, Any]:
+    """Overlap report for a *recorded* schedule: feed it the event list
+    ``profiler.trace.pipeline_schedule_events()`` returns (the flight
+    recorder stores each scheduled unit verbatim in this module's event
+    schema) and the exact simulator rules above score it — so a measured
+    trace and ``schedule_events`` for the same (pp, n_micro, overlap)
+    agree bit-for-bit, ordering included."""
+    events = list(events)
+    return {"transfer_stats": transfer_stats(events),
+            "overlap_fraction": overlap_fraction(events),
+            "n_events": len(events)}

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..profiler import trace as _trace
+
 __all__ = ["pipeline_forward", "pipeline_loss_fn",
            "pipeline_1f1b_value_and_grad",
            "pipeline_interleaved_forward", "pipeline_interleaved_loss_fn"]
@@ -180,6 +182,13 @@ def pipeline_1f1b_value_and_grad(cfg, mesh, n_micro, params, batch,
     """
     from ..models.llama import _rope_tables, _rms_norm, run_layer_stack
     from .overlap import schedule_constants
+
+    # host-side build marker (the scan body itself is opaque to the
+    # flight recorder — measured overlap comes from the recorded
+    # schedule, see trace.record_pipeline_schedule)
+    _trace.event("pipeline/build", kind="pipeline_build",
+                 pp=int(mesh.shape["pp"]), n_micro=int(n_micro),
+                 overlap=bool(overlap))
 
     ids, labels = batch["input_ids"], batch["labels"]
     B, S = ids.shape

@@ -35,6 +35,8 @@ import json
 import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..profiler import trace as _trace
+
 __all__ = ["Plan", "PlanError", "PlanCompilationError",
            "PlanVerificationError", "SCHEDULES"]
 
@@ -89,6 +91,40 @@ def _as_sharding_tree(tree, mesh):
 
 def _error_findings(findings):
     return [f for f in findings if getattr(f, "severity", "") == "error"]
+
+
+def _wrap_step_tracing(plan: "Plan", step_fn: Callable) -> Callable:
+    """Per-rank train-step spans for the flight recorder.
+
+    Each invocation emits a shared-name barrier event (the anchor
+    ``trace.merge_ranks`` aligns rank clocks on) and wraps the step in a
+    ``train/step`` span; the first traced step of a pipelined plan also
+    records the static 1F1B schedule via
+    ``trace.record_pipeline_schedule`` so ``tools/trace_report.py`` can
+    compute measured overlap with the simulator's exact event schema.
+    Tracing off → one dict lookup per step, step_fn runs untouched.
+    """
+    counter = {"n": 0}
+
+    def traced(params, opt_state, batch):
+        if not _trace.enabled():
+            return step_fn(params, opt_state, batch)
+        n = counter["n"]
+        counter["n"] += 1
+        if n == 0 and plan.pp > 1 and plan.schedule != "none":
+            _trace.record_pipeline_schedule(
+                plan.pp, plan.n_microbatches or plan.pp,
+                overlap=plan.overlap, step=n)
+        _trace.barrier(f"train/step{n}")
+        with _trace.span("train/step", step=n, dp=plan.dp, pp=plan.pp,
+                         schedule=plan.schedule, overlap=plan.overlap):
+            return step_fn(params, opt_state, batch)
+
+    for attr in ("jitted", "abstract_state", "batch_shardings", "plan",
+                 "plan_topology"):
+        if hasattr(step_fn, attr):
+            setattr(traced, attr, getattr(step_fn, attr))
+    return traced
 
 
 @dataclasses.dataclass
@@ -294,7 +330,7 @@ class Plan:
         if not do_verify:
             step_fn.plan = self
             step_fn.plan_topology = topo
-            return step_fn, init_fn
+            return _wrap_step_tracing(self, step_fn), init_fn
 
         state = {"checked": False}
         inner = step_fn
@@ -313,7 +349,7 @@ class Plan:
         verified_step.batch_shardings = inner.batch_shardings
         verified_step.plan = self
         verified_step.plan_topology = topo
-        return verified_step, init_fn
+        return _wrap_step_tracing(self, verified_step), init_fn
 
     # -- spec round-trip ----------------------------------------------------
     def to_spec(self) -> Dict[str, Any]:

@@ -14,6 +14,8 @@ Telemetry siblings in this package:
   xmem.py             — per-executable memory/cost analysis capture
   numerics.py         — NaN/Inf watchdog + first-bad-op localization
                         (FLAGS_tpu_check_nan_inf)
+  trace.py            — structured event/span flight recorder with
+                        JSONL sidecars (FLAGS_tpu_trace)
 """
 from __future__ import annotations
 
@@ -31,10 +33,11 @@ from . import metrics
 from . import compile_tracker
 from . import xmem
 from . import numerics
+from . import trace
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "export_chrome_tracing", "benchmark", "metrics",
-           "compile_tracker", "xmem", "numerics"]
+           "compile_tracker", "xmem", "numerics", "trace"]
 
 # host-span aggregation for the summary stats table (reference:
 # profiler/profiler_statistic.py — EventSummary/statistic_data tables).
@@ -168,6 +171,31 @@ def _record_span(name: str):
     return contextlib.nullcontext()
 
 
+def _metadata_events(events: list) -> list:
+    """Chrome "M" metadata events naming every pid/tid seen in
+    `events`: the host process keeps its real pid ("host <pid>"),
+    structured-trace events use the rank as pid ("rank <N>"), so a
+    merged multi-rank trace groups into legible Perfetto tracks."""
+    host_pid = os.getpid()
+    pids = []
+    tids = []
+    for e in events:
+        pid, tid = e.get("pid"), e.get("tid")
+        if pid is not None and pid not in pids:
+            pids.append(pid)
+        if pid is not None and tid is not None and (pid, tid) not in tids:
+            tids.append((pid, tid))
+    meta = []
+    for pid in pids:
+        name = f"host {pid}" if pid == host_pid else f"rank {pid}"
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": name}})
+    for pid, tid in tids:
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": f"thread {tid}"}})
+    return meta
+
+
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
@@ -238,15 +266,22 @@ class Profiler:
 
     def export(self, path: Optional[str] = None):
         """Write the buffered host spans as a Chrome trace_event file
-        (the `{"traceEvents": [...]}` object form). Returns the path."""
+        (the `{"traceEvents": [...]}` object form). Structured events
+        from `profiler.trace` (when FLAGS_tpu_trace is on) are merged
+        into the same file, and process_name/thread_name metadata
+        events label every pid/tid so multi-rank merged traces read as
+        named tracks in Perfetto. Returns the path."""
         if path is None:
             path = os.path.join(self._log_dir,
                                 f"host_{os.getpid()}.pt.trace.json")
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        events = list(self._trace_events)
+        if trace.enabled():
+            events.extend(trace.chrome_events())
         payload = {
-            "traceEvents": list(self._trace_events),
+            "traceEvents": _metadata_events(events) + events,
             "displayTimeUnit": "ms",
             "metadata": {"producer": "paddle_tpu.profiler",
                          "steps": self._step},

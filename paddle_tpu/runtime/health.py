@@ -43,7 +43,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Set
 
-from .watchdog import PhaseTimeout, record_incident, _dump_all_threads
+from .watchdog import (PhaseTimeout, record_incident, persist_incidents,
+                       _dump_all_threads)
 
 __all__ = ["CollectiveTimeout", "HealthMonitor", "HeartbeatTracker",
            "install", "uninstall", "get", "monitored", "current_step",
@@ -355,6 +356,12 @@ class HealthMonitor:
             # checkpoint stays valid (crash-consistent commit, PR 5)
             except Exception as e:
                 record_incident("final_save_failed", error=str(e)[-500:])
+        # exit_fn defaults to os._exit, which skips atexit — flush the
+        # incident buffer now or the post-mortem sidecar never lands
+        try:
+            persist_incidents()
+        except OSError as e:
+            record_incident("incident_persist_failed", error=str(e)[-500:])
         self._exit_fn(RELAUNCH_EXIT_CODE)
 
     # -- lifecycle -----------------------------------------------------------

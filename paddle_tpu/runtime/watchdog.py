@@ -37,7 +37,9 @@ and *when* instead of silently carrying stale numbers forward.
 """
 from __future__ import annotations
 
+import atexit
 import faulthandler
+import json
 import os
 import sys
 import threading
@@ -47,7 +49,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["PhaseTimeout", "Watchdog", "run_with_deadline",
            "init_with_retries", "record_incident", "incidents",
-           "clear_incidents", "last_incident", "PHASES", "phase",
+           "clear_incidents", "last_incident", "persist_incidents",
+           "incident_sidecar_path", "INCIDENT_SCHEMA", "PHASES", "phase",
            "global_watchdog"]
 
 # canonical phases and the flag holding each deadline (seconds; <= 0
@@ -88,16 +91,27 @@ class PhaseTimeout(TimeoutError):
 _INCIDENTS: List[Dict[str, Any]] = []
 _INCIDENTS_MAX = 64
 _INCIDENTS_LOCK = threading.Lock()
+_PERSIST_REGISTERED = False
+
+INCIDENT_SCHEMA = "paddle_tpu.incidents.v1"
 
 
 def record_incident(kind: str, **fields) -> Dict[str, Any]:
-    """Append a structured incident ``{kind, time, rank, **fields}``."""
+    """Append a structured incident ``{kind, time, rank, **fields}``.
+    The first record arms an atexit hook that persists the buffer to a
+    JSONL sidecar, so incidents survive the process for
+    ``tools/trace_report.py --incidents`` post-mortems (exit-101 paths
+    bypass atexit and call :func:`persist_incidents` explicitly)."""
     rec = {"kind": kind, "time": time.time(),
            "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0"))}
     rec.update(fields)
+    global _PERSIST_REGISTERED
     with _INCIDENTS_LOCK:
         _INCIDENTS.append(rec)
         del _INCIDENTS[:-_INCIDENTS_MAX]
+        if not _PERSIST_REGISTERED:
+            _PERSIST_REGISTERED = True
+            atexit.register(_persist_at_exit)
     from ..profiler import metrics
     if metrics.enabled():
         metrics.counter("health_incidents_total",
@@ -119,6 +133,55 @@ def last_incident() -> Optional[Dict[str, Any]]:
 def clear_incidents():
     with _INCIDENTS_LOCK:
         del _INCIDENTS[:]
+
+
+def incident_sidecar_path() -> str:
+    """Where :func:`persist_incidents` writes by default:
+    ``$PADDLE_TPU_INCIDENTS_OUT`` when set, else
+    ``incidents_rank<N>.jsonl`` under ``$PADDLE_TPU_INCIDENT_DIR``
+    (default: the current directory)."""
+    explicit = os.environ.get("PADDLE_TPU_INCIDENTS_OUT")
+    if explicit:
+        return explicit
+    base = os.environ.get("PADDLE_TPU_INCIDENT_DIR", ".")
+    try:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    except ValueError:
+        rank = 0
+    return os.path.join(base, f"incidents_rank{rank}.jsonl")
+
+
+def persist_incidents(path: Optional[str] = None) -> Optional[str]:
+    """Flush the incident buffer to a JSONL sidecar (header line with
+    the schema/rank/pid, then one incident per line; atomic tmp-file +
+    rename). No-op when the buffer is empty. Called automatically at
+    normal interpreter exit once an incident exists; exit-101 paths
+    (``HealthMonitor._convert``, bench's never-exit-silent harness)
+    call it explicitly because ``os._exit`` skips atexit."""
+    recs = incidents()
+    if not recs:
+        return None
+    path = path or incident_sidecar_path()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    header = {"schema": INCIDENT_SCHEMA, "pid": os.getpid(),
+              "rank": recs[-1].get("rank", 0), "wall_time": time.time(),
+              "count": len(recs)}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(header, sort_keys=True) + "\n")
+        for rec in recs:
+            f.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def _persist_at_exit():
+    try:
+        persist_incidents()
+    except OSError as exc:  # read-only cwd etc. — losing the sidecar
+        sys.stderr.write(f"watchdog: incident persist failed: {exc}\n")
 
 
 def _dump_all_threads(reason: str):

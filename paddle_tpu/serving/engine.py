@@ -57,6 +57,7 @@ import numpy as np
 
 from ..profiler import metrics as _metrics
 from ..profiler import numerics as _numerics
+from ..profiler import trace as _trace
 from ..profiler import xmem as _xmem
 from ..runtime.watchdog import (PhaseTimeout, Watchdog, global_watchdog,
                                 record_incident)
@@ -142,6 +143,8 @@ def summary_lines() -> List[str]:
         f"{int(s['replicas_dead'])} dead  "
         f"{int(s['drains'])} drains  "
         f"callback errors: {int(s['callback_errors'])}")
+    from . import router as _router  # function-local: router imports us
+    lines.extend(_router.replica_summary_lines())
     return lines
 
 
@@ -245,6 +248,11 @@ class LLMEngine:
         self._shedding = False
         self._ttft_s: List[float] = []
         self._latency_s: List[float] = []
+        # TTFT/latency decomposition (engine clock; queue + prefill
+        # sums to TTFT by construction, + decode to latency)
+        self._queue_s: List[float] = []
+        self._prefill_s: List[float] = []
+        self._decode_s: List[float] = []
 
         self.kv = PagedKVCache(self.num_pages, self.page_size,
                                self.max_blocks)
@@ -274,6 +282,9 @@ class LLMEngine:
         self._step_fns: Dict[int, Callable] = {}
         self._requests: Dict[int, Request] = {}
         self._steps = 0
+        # rids scheduled in the previous step — the edge detector for
+        # per-request "admitted" trace events (incl. re-admissions)
+        self._sched_rids: set = set()
 
         # -- work reuse: shared-prefix KV cache + speculative decoding
         self._prefix_enabled = bool(prefix_cache)
@@ -326,6 +337,10 @@ class LLMEngine:
                 _metrics.counter(
                     "serve_shed_total",
                     "Requests rejected by admission control").inc()
+            # rid -1: the request was never created, but the shed event
+            # still belongs in the flight recorder's serving timeline
+            _trace.request_event("shed", -1, t=self._clock(),
+                                 queue_depth=depth)
             raise AdmissionRejected(
                 f"admission queue at {depth}/{self.max_queue}; "
                 f"shedding until it drains below {self.max_queue // 2} "
@@ -343,6 +358,10 @@ class LLMEngine:
                                   else now + float(deadline_s)))
         self.scheduler.add(req)
         self._requests[req.rid] = req
+        _trace.request_event("queued", req.rid, t=now,
+                             prompt_len=len(req.prompt),
+                             max_new_tokens=req.max_new_tokens,
+                             deadline_s=req.deadline_s)
         _STATS["requests_added"] += 1
         if _metrics.enabled():
             _metrics.gauge("serve_queue_depth",
@@ -463,6 +482,8 @@ class LLMEngine:
         for req in active:
             if req.deadline_s is None or now <= req.deadline_s:
                 continue
+            _trace.request_event("deadline_expired", req.rid, t=now,
+                                 overrun_s=now - req.deadline_s)
             self.scheduler.remove(
                 req, now_s=now, state=RequestState.FAILED,
                 error=DeadlineExceeded(
@@ -470,6 +491,14 @@ class LLMEngine:
                     f"{now - req.deadline_s:.3f}s "
                     f"({len(req.output)} tokens streamed)"))
             _STATS["deadline_expired"] += 1
+            if _trace.enabled():
+                # post-mortem: the expired request's full lifecycle
+                # rides into the incident buffer (and, via
+                # persist_incidents, the incident sidecar)
+                record_incident(
+                    "serve_deadline_expired", rid=int(req.rid),
+                    overrun_s=float(now - req.deadline_s),
+                    timeline=self.request_timeline(req.rid)[-32:])
             if _metrics.enabled():
                 _metrics.counter(
                     "serve_deadline_expired_total",
@@ -482,6 +511,22 @@ class LLMEngine:
         now = self._clock()
         self._expire_deadlines(now)
         plan = self.scheduler.schedule()
+        tracing = _trace.enabled()
+        if tracing:
+            for req in plan.preempted:
+                _trace.request_event("preempted", req.rid, t=now)
+        for s in plan.seqs:
+            req = s.request
+            if tracing and req.rid not in self._sched_rids:
+                _trace.request_event(
+                    "admitted", req.rid, t=now, slot=s.slot,
+                    prefix_hit=req.fed,
+                    readmission=req.admitted_s is not None)
+            if req.admitted_s is None:
+                # first admission only: preemption replay keeps the
+                # original stamp so queue time stays arrival->admission
+                req.admitted_s = now
+        self._sched_rids = {s.request.rid for s in plan.seqs}
         if plan.admission_blocked:
             # the pool (not the slot array) is the bottleneck: the
             # head-of-line request stays queued, never dropped
@@ -513,8 +558,10 @@ class LLMEngine:
             plan.seqs, R, Tc, self.max_blocks, self.kv, drafts)
 
         try:
-            nxt = self._guarded_forward(plan, tokens, tbl, lens, qlens,
-                                        Tc)
+            with _trace.span("serve/step", step=self._steps,
+                             batch=len(plan.seqs), bucket=Tc):
+                nxt = self._guarded_forward(plan, tokens, tbl, lens,
+                                            qlens, Tc)
         except ReplicaKilled:
             # whole-replica death is the router's failure domain, not a
             # step-recoverable fault — propagate
@@ -540,14 +587,29 @@ class LLMEngine:
                 spec_proposed += s.spec
                 spec_accepted += len(emitted) - 1
                 decode += len(emitted)
+                if tracing:
+                    _trace.request_event(
+                        "spec", s.request.rid, t=now, proposed=s.spec,
+                        accepted=len(emitted) - 1)
             elif s.produces:
                 out[s.slot] = int(nxt[s.slot, s.q_len - 1])
                 if s.q_len == 1:
                     decode += 1
+                    if tracing:
+                        _trace.request_event("decode", s.request.rid,
+                                             t=now, tokens=1)
                 else:
                     prefill += s.q_len
+                    if tracing:
+                        _trace.request_event(
+                            "prefill", s.request.rid, t=now,
+                            tokens=s.q_len, last_chunk=True)
             else:
                 prefill += s.q_len
+                if tracing:
+                    _trace.request_event(
+                        "prefill", s.request.rid, t=now,
+                        tokens=s.q_len, last_chunk=False)
         finished = self.scheduler.apply(plan, out, now_s=now)
         self._steps += 1
 
@@ -569,8 +631,13 @@ class LLMEngine:
             r = s.request
             if r.first_token_s is not None and r.first_token_s == now:
                 self._ttft_s.append(now - r.arrival_s)
+                if r.admitted_s is not None:
+                    self._queue_s.append(r.admitted_s - r.arrival_s)
+                    self._prefill_s.append(now - r.admitted_s)
         for r in finished:
             self._latency_s.append(now - r.arrival_s)
+            if r.first_token_s is not None:
+                self._decode_s.append(now - r.first_token_s)
         if _metrics.enabled():
             _metrics.gauge("serve_queue_depth",
                            "Requests waiting for admission").set(
@@ -683,6 +750,12 @@ class LLMEngine:
             self._draft.reset()
         demoted = self.scheduler.reset_running()
         self.scheduler.requeue_front(demoted)
+        self._sched_rids.clear()
+        if _trace.enabled():
+            now = self._clock()
+            for req in demoted:
+                _trace.request_event("replay", req.rid, t=now,
+                                     replayed_tokens=req.num_known)
         return demoted
 
     def _probe(self, group: List[Request]) -> bool:
@@ -749,7 +822,11 @@ class LLMEngine:
             # probing a genuinely hung fault would hang recovery too;
             # hangs replay wholesale instead
             culprit = self._bisect(suspects)
+        _trace.event("serve/recovery", kind="engine", failure=failure,
+                     step=int(self._steps), batch=len(suspects))
         if culprit is not None:
+            _trace.request_event("quarantine", culprit.rid,
+                                 t=self._clock(), failure=failure)
             self.scheduler.remove(
                 culprit, now_s=self._clock(),
                 state=RequestState.FAILED,
@@ -785,7 +862,12 @@ class LLMEngine:
     # -- SLO reporting ----------------------------------------------------
     def slo_report(self) -> Dict[str, Optional[float]]:
         """Observed TTFT/latency p95 against the configured SLOs; the
-        ``*_ok`` entries are None when no target is set."""
+        ``*_ok`` entries are None when no target is set.  ``breakdown``
+        decomposes where the time went: per-request queue
+        (arrival → first admission) and prefill (admission → first
+        token) components sum to that request's TTFT by construction,
+        and decode (first token → finish) extends the sum to its full
+        latency."""
 
         def _p95(xs):
             return float(np.percentile(xs, 95)) if xs else None
@@ -802,7 +884,25 @@ class LLMEngine:
             rep["ttft_ok"] = ttft <= slo.ttft_p95_s
         if slo.latency_p95_s is not None and lat is not None:
             rep["latency_ok"] = lat <= slo.latency_p95_s
+        rep["breakdown"] = {
+            "queue_p95_s": _p95(self._queue_s),
+            "prefill_p95_s": _p95(self._prefill_s),
+            "decode_p95_s": _p95(self._decode_s),
+            "queue_mean_s": (float(np.mean(self._queue_s))
+                             if self._queue_s else None),
+            "prefill_mean_s": (float(np.mean(self._prefill_s))
+                               if self._prefill_s else None),
+            "decode_mean_s": (float(np.mean(self._decode_s))
+                              if self._decode_s else None),
+            "samples": len(self._queue_s),
+        }
         return rep
+
+    def request_timeline(self, rid: int) -> List[dict]:
+        """Every flight-recorder event for one request (requires
+        FLAGS_tpu_trace; empty list otherwise) — the post-mortem view
+        dumped into the incident buffer on deadline expiry."""
+        return _trace.request_timeline(rid)
 
     # -- convenience -----------------------------------------------------
     def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:

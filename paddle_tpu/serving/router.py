@@ -46,7 +46,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from ..profiler import metrics as _metrics
+from ..profiler import metrics as _metrics, trace as _trace
 from ..runtime.health import HeartbeatTracker
 from ..runtime.watchdog import record_incident, run_with_deadline
 from ..testing.chaos import chaos_point
@@ -55,13 +55,41 @@ from .errors import (AdmissionRejected, DeadlineExceeded,
                      ReplicaUnavailable)
 from .scheduler import RequestState
 
-__all__ = ["Router", "RouterRequest", "ReplicaState", "EngineReplica"]
+__all__ = ["Router", "RouterRequest", "ReplicaState", "EngineReplica",
+           "replica_summary_lines", "reset_replica_stats"]
 
 _LOG = logging.getLogger("paddle_tpu.serving")
 _GIDS = itertools.count()
 
 # replicas remember this many recent prompt prefixes for locality
 _PREFIX_LRU = 64
+
+# per-replica placement/failure tallies for the Profiler "Serving"
+# section (the process-wide _STATS in engine.py stay the aggregate)
+_REPLICA_STATS: Dict[str, Dict[str, int]] = {}
+_REPLICA_KEYS = ("placed", "shed", "failovers", "drains", "dead")
+
+
+def _replica_stat(name: str, key: str, n: int = 1) -> None:
+    stats = _REPLICA_STATS.setdefault(name, dict.fromkeys(_REPLICA_KEYS, 0))
+    stats[key] += n
+
+
+def replica_summary_lines() -> List[str]:
+    """Per-replica rows for the Profiler "Serving" section; empty when
+    no router has placed anything this process."""
+    lines: List[str] = []
+    for name in sorted(_REPLICA_STATS):
+        s = _REPLICA_STATS[name]
+        lines.append(
+            f"  replica {name}: placed={s['placed']} shed={s['shed']} "
+            f"failovers={s['failovers']} drains={s['drains']} "
+            f"dead={s['dead']}")
+    return lines
+
+
+def reset_replica_stats() -> None:
+    _REPLICA_STATS.clear()
 
 
 class ReplicaState(enum.Enum):
@@ -215,6 +243,11 @@ class Router:
                     prompt, remaining, eos_token_id=rr.eos_token_id,
                     on_token=self._stream_cb(rr), deadline_s=deadline_s)
             except AdmissionRejected:
+                _replica_stat(rep.name, "shed")
+                if _metrics.enabled():
+                    _metrics.counter("serve_router_shed_total",
+                                     "Placements refused by a shedding "
+                                     "replica", replica=rep.name).inc()
                 continue
             key = self._prefix_key(prompt)
             rep.prefixes[key] = None
@@ -223,6 +256,14 @@ class Router:
                 rep.prefixes.popitem(last=False)
             rr.replica, rr.rid = rep.name, rid
             self._placed[(rep.name, rid)] = rr
+            _replica_stat(rep.name, "placed")
+            if _metrics.enabled():
+                _metrics.counter("serve_router_placed_total",
+                                 "Requests seated on a replica",
+                                 replica=rep.name).inc()
+            _trace.event("route/place", kind="router", gid=rr.gid,
+                         replica=rep.name, rid=rid,
+                         migration=rr.migrations)
             return True
         return False
 
@@ -291,6 +332,9 @@ class Router:
         rep.state = ReplicaState.DEAD
         self._tracker.forget(name)
         _engine._STATS["replicas_dead"] += 1
+        _replica_stat(name, "dead")
+        _trace.event("route/replica_dead", kind="router", replica=name,
+                     reason=reason[:200])
         record_incident("serve_replica_dead", replica=name,
                         reason=reason[:200])
         if _metrics.enabled():
@@ -308,14 +352,20 @@ class Router:
         Idempotent by construction: the resubmitted prompt is
         ``prompt + delivered``, so the continuation starts exactly
         after the last token the caller already received."""
+        src = rr.replica
         self._placed.pop((rr.replica, rr.rid), None)
         rr.replica = rr.rid = None
         rr.migrations += 1
         _engine._STATS["failovers"] += 1
+        if src is not None:
+            _replica_stat(src, "failovers")
+        _trace.event("route/failover", kind="router", gid=rr.gid,
+                     src=src, delivered=len(rr.tokens))
         if _metrics.enabled():
             _metrics.counter("serve_failovers_total",
                              "In-flight requests migrated off a dead "
-                             "or draining replica").inc()
+                             "or draining replica",
+                             replica=(src or "none")).inc()
         if len(rr.tokens) >= rr.max_new_tokens or rr.finished:
             rr.finished = True
             return
@@ -331,6 +381,8 @@ class Router:
             return 0
         rep.state = ReplicaState.DRAINING
         _engine._STATS["drains"] += 1
+        _replica_stat(name, "drains")
+        _trace.event("route/drain", kind="router", replica=name)
         record_incident("serve_replica_drain", replica=name)
         if _metrics.enabled():
             _metrics.counter("serve_drains_total",

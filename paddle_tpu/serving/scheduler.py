@@ -31,6 +31,7 @@ import itertools
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
+from ..profiler import trace as _trace
 from .kv_cache import PagedKVCache, _cdiv
 
 __all__ = ["Request", "RequestState", "Scheduler", "StepPlan",
@@ -58,6 +59,7 @@ class Request:
     fed: int = 0                          # tokens written to kv
     output: List[int] = dataclasses.field(default_factory=list)
     arrival_s: float = 0.0
+    admitted_s: Optional[float] = None    # first admission (engine clock)
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
     deadline_s: Optional[float] = None    # absolute, engine clock
@@ -218,6 +220,13 @@ class Scheduler:
         req.state = state
         req.error = error
         req.finish_s = now_s
+        # the terminal trace event is emitted HERE, at the single site
+        # every terminal transition funnels through, so "exactly one
+        # terminal event per admitted request" holds by construction
+        _trace.request_event(state.value, req.rid, t=now_s,
+                             tokens=len(req.output),
+                             error=(None if error is None
+                                    else str(error)[:200]))
 
     def reset_running(self) -> List[Request]:
         """Pool-rebuild support: demote every running request back to
@@ -247,6 +256,8 @@ class Scheduler:
         self._release_slot(req)
         req.state = RequestState.FINISHED
         req.finish_s = now_s
+        _trace.request_event("finish", req.rid, t=now_s,
+                             tokens=len(req.output))
 
     def schedule(self) -> StepPlan:
         """Build the next step: grow running requests' tables (with
@@ -354,6 +365,7 @@ class Scheduler:
                 appended += 1
                 if req.first_token_s is None:
                     req.first_token_s = now_s
+                    _trace.request_event("first_token", req.rid, t=now_s)
                 done = req.done
                 if req.on_token is not None:
                     req.on_token(req.rid, tok, done)

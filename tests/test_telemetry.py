@@ -152,8 +152,13 @@ class TestChromeTrace:
         assert path.exists()
         with open(path) as f:
             data = json.load(f)
-        events = data["traceEvents"]
-        assert isinstance(events, list)
+        all_events = data["traceEvents"]
+        assert isinstance(all_events, list)
+        # the export names its pid/tid tracks with "M" metadata events
+        meta = [e for e in all_events if e["ph"] == "M"]
+        assert {e["name"] for e in meta} == {"process_name",
+                                             "thread_name"}
+        events = [e for e in all_events if e["ph"] != "M"]
         # complete ("X") events carry the begin/end pair in one record
         assert len(events) == 4
         by_name = {}
@@ -177,7 +182,8 @@ class TestChromeTrace:
         path = p.export()
         with open(path) as f:
             data = json.load(f)
-        assert [e["name"] for e in data["traceEvents"]] == ["x"]
+        assert [e["name"] for e in data["traceEvents"]
+                if e.get("ph") != "M"] == ["x"]
 
     def test_ready_state_does_not_buffer_spans(self, tmp_path):
         # scheduler starts CLOSED->READY; spans before RECORD must not
