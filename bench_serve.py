@@ -35,6 +35,13 @@ quarantined / deadline-expired counts for the measured run, plus the
 observed-vs-target SLO verdicts) so overload and chaos E2E runs are
 assertable from the one-line contract.
 
+``--kv-dtype int8`` (or _KV_DTYPE=int8) serves the same workload over
+the quantized paged KV cache (int8 pages + per-page f32 scale pools;
+parity-within-tolerance vs the bf16 pools, not bit-identical) and the
+JSON line carries a ``kv`` block: page dtype, pool pages, scale-pool
+bytes, and the pool's predicted max-concurrent capacity — the
+measured side of the ``pod_report.py serving --kv-dtype`` prediction.
+
 ``--trace-out DIR`` (or _TRACE_OUT) turns on the flight recorder for
 the measured run: every request's lifecycle events (queued -> admitted
 -> prefill -> first token -> decode -> terminal) land in a rank-tagged
@@ -104,6 +111,12 @@ def main():
     if workload not in ("uniform", "shared-prefix"):
         raise ValueError(f"unknown --workload {workload!r} "
                          "(uniform | shared-prefix)")
+    kv_dtype = os.environ.get("PADDLE_TPU_BENCH_SERVE_KV_DTYPE", "bf16")
+    if "--kv-dtype" in sys.argv:
+        kv_dtype = sys.argv[sys.argv.index("--kv-dtype") + 1]
+    if kv_dtype not in ("bf16", "int8"):
+        raise ValueError(f"unknown --kv-dtype {kv_dtype!r} "
+                         "(bf16 | int8)")
     trace_out = os.environ.get("PADDLE_TPU_BENCH_SERVE_TRACE_OUT")
     if "--trace-out" in sys.argv:
         trace_out = sys.argv[sys.argv.index("--trace-out") + 1]
@@ -150,6 +163,8 @@ def main():
                             num_pages=int(pages_env) if pages_env
                             else None,
                             max_model_len=max_model_len,
+                            kv_dtype=(kv_dtype if kv_dtype != "bf16"
+                                      else None),
                             max_queue=max_queue, slo=slo, **reuse_kw)
 
     rng = np.random.RandomState(0)
@@ -325,6 +340,16 @@ def main():
         "max_running": max_running,
         "chunk": chunk,
         "page_size": page,
+        # predicted-vs-measured capacity: the pool's own arithmetic
+        # (pages / blocks-per-request), pod_report serving's measured
+        # counterpart for the BENCH_SERVE trajectory
+        "kv": {
+            "dtype": kv_dtype,
+            "pages": int(eng.num_pages),
+            "scale_pool_bytes": int(eng._scale_bytes),
+            "max_concurrent_predicted":
+                (eng.num_pages - 1) // eng.max_blocks,
+        },
         "preset": preset,
         "device": getattr(dev, "device_kind", dev.platform),
         "chips": n_chips,

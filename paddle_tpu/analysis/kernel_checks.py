@@ -578,30 +578,46 @@ class _SiteChecker:
         for s in self.site.scratch_shapes:
             scratch_bytes += (math.prod(int(d) for d in s.shape)
                               * _dtype_itemsize(s.dtype))
-        total = block_bytes + scratch_bytes
+        # scalar-prefetch operands (block tables, per-page scale pools)
+        # have no BlockSpec but are resident whole for the kernel's
+        # lifetime — a quantized-KV scale pool left out of the estimate
+        # would understate the footprint exactly where it grew
+        scalar_bytes = 0
+        for o in self.site.scalar_operands:
+            shape = getattr(o, "shape", None)
+            dtype = getattr(o, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            scalar_bytes += (math.prod(int(d) for d in shape)
+                             * _dtype_itemsize(dtype))
+        total = block_bytes + scratch_bytes + scalar_bytes
         budget, gen = self._vmem_budget()
-        self._record_estimate(block_bytes, scratch_bytes, budget, gen)
+        self._record_estimate(block_bytes, scratch_bytes, scalar_bytes,
+                              budget, gen)
         if total > budget and self._want("kernel-vmem-budget"):
             self._emit(
                 "kernel-vmem-budget",
                 f"{self.site.kernel_name}: estimated VMEM footprint "
                 f"{total / (1 << 20):.1f} MiB (blocks "
                 f"{block_bytes / (1 << 20):.1f} + scratch "
-                f"{scratch_bytes / (1 << 20):.1f}) exceeds the {gen} "
+                f"{scratch_bytes / (1 << 20):.1f} + scalar operands "
+                f"{scalar_bytes / (1 << 20):.1f}) exceeds the {gen} "
                 f"budget of {budget / (1 << 20):.0f} MiB — shrink the "
                 "block sizes or stream the large operand "
                 "(config key 'vmem_budget_bytes' overrides the budget)",
                 vmem_bytes=total, budget_bytes=budget, generation=gen)
 
-    def _record_estimate(self, block_bytes, scratch_bytes, budget, gen):
+    def _record_estimate(self, block_bytes, scratch_bytes, scalar_bytes,
+                         budget, gen):
         try:
             from ..profiler import xmem as _xmem
         except ImportError:  # standalone analysis load — no profiler
             return
         _xmem.record_kernel_estimate(
             self.site.kernel_name,
-            vmem_bytes=block_bytes + scratch_bytes,
+            vmem_bytes=block_bytes + scratch_bytes + scalar_bytes,
             block_bytes=block_bytes, scratch_bytes=scratch_bytes,
+            scalar_bytes=scalar_bytes,
             budget_bytes=budget, generation=gen,
             grid=list(self.site.grid),
             where=f"{self.site.file}:{self.site.line}")

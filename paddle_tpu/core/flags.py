@@ -82,6 +82,17 @@ define_flag("FLAGS_tpu_fused_blocks", "auto",
             "the Pallas interpreter in tests), 'on' forces the fused "
             "path wherever the kernels can run, 'off' keeps the unfused "
             "reference composition everywhere.")
+define_flag("FLAGS_tpu_quantized", "auto",
+            "int8 weight path for serving (ops.pallas_ops.int8_matmul "
+            "behind models.llama quantize_params): 'auto' engages the "
+            "Pallas int8 kernels on TPU only (CPU always serves the "
+            "jnp dequant oracle — same math, so 'auto' == 'on' "
+            "numerically wherever the kernel qualifies), 'on' forces "
+            "the quantized weight path everywhere incl. CPU, 'off' "
+            "keeps dense weights. LlamaConfig.quantized overrides "
+            "per-model; this flag is the default for configs that "
+            "leave it None. The quantized KV cache is a separate knob "
+            "(LLMEngine kv_dtype / bench_serve --kv-dtype).")
 define_flag("FLAGS_tpu_persistent_cache", False,
             "Persistent XLA compilation cache for every compile in the "
             "process: jit/to_static AOT compiles (via profiler.xmem), "

@@ -23,6 +23,14 @@ around the original module:
 The converted artifact keeps the jit.save format, so both the python
 Predictor and the native C serving host (csrc/predictor_capi.cc) load
 it unchanged.
+
+For in-framework serving (LLMEngine over models/llama.py) the same
+absmax rule feeds the Pallas int8 matmul kernels directly:
+``models.llama.quantize_params`` produces ``{"q", "scale"}`` leaves
+consumed by ``ops.pallas_ops.int8_matmul`` (int8×int8→int32 MXU
+accumulate, dequant epilogue) instead of edge-of-graph dequant — see
+docs/performance.md.  Both paths record ``quant_err_*`` gauges behind
+FLAGS_tpu_check_nan_inf.
 """
 from __future__ import annotations
 
@@ -40,13 +48,42 @@ _INT8_MIN_SIZE = 1024
 
 def _absmax_scale(w: np.ndarray, axis=None) -> np.ndarray:
     """Symmetric absmax scale (quantization/quanters AbsmaxObserver
-    rule), per-channel when axis is given."""
+    rule), per-channel when axis is given.
+
+    Dead (all-zero) and non-finite channels get the benign scale
+    1/127: their weights quantize to 0 and dequantize to exact 0.  An
+    epsilon clamp is NOT enough — 1e-8/127 ≈ 7.9e-11 underflows to
+    exactly 0.0 when a downstream consumer stores the scale in float16
+    (subnormal floor ~6e-8), and a zero scale turns dequant into
+    inf/NaN."""
     if axis is None:
-        m = np.max(np.abs(w))
-        return np.asarray(max(float(m), 1e-8) / 127.0, np.float32)
+        m = float(np.max(np.abs(w)))
+        if not np.isfinite(m) or m <= 0.0:
+            m = 1.0
+        return np.asarray(m / 127.0, np.float32)
     m = np.max(np.abs(w), axis=tuple(i for i in range(w.ndim)
                                      if i != axis), keepdims=True)
-    return (np.maximum(m, 1e-8) / 127.0).astype(np.float32)
+    m = np.where(np.isfinite(m) & (m > 0.0), m, 1.0)
+    return (m / 127.0).astype(np.float32)
+
+
+def _note_quant_err(name: str, w: np.ndarray, q: np.ndarray,
+                    scale: np.ndarray) -> None:
+    """Conversion-time quantization-error gauges for the numerics
+    watchdog ("Quantization" block of the Numerics summary): rms and
+    absmax of (dequant - reference) per converted array.  Behind
+    FLAGS_tpu_check_nan_inf via numerics.enabled()."""
+    from ..profiler import numerics
+    if not numerics.enabled():
+        return
+    err = q.astype(np.float32) * scale.astype(np.float32) \
+        - w.astype(np.float32)
+    if err.size == 0:
+        return
+    numerics.note(f"quant_err_rms_{name}",
+                  float(np.sqrt(np.mean(err * err))))
+    numerics.note(f"quant_err_absmax_{name}",
+                  float(np.max(np.abs(err))))
 
 
 def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
@@ -107,6 +144,7 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
                 new_params[k + "::q"] = q
                 new_params[k + "::scale"] = scale
                 quantized[k] = True
+                _note_quant_err(k, v, q, scale)
             else:
                 new_params[k] = v
                 quantized[k] = False

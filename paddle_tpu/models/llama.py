@@ -42,7 +42,7 @@ from .decoding import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "init_params", "forward_pure",
            "forward_with_cache", "forward_paged", "build_train_step",
-           "param_specs", "PRESETS", "preset"]
+           "param_specs", "PRESETS", "preset", "quantize_params"]
 
 
 @dataclasses.dataclass
@@ -73,6 +73,11 @@ class LlamaConfig:
     # kernels can run (incl. the interpreter — what parity tests use),
     # "off" = always the unfused composition
     fused_blocks: Any = None
+    # int8 weight path for serving (quantize_params + the pallas_ops
+    # int8_matmul kernels): None follows FLAGS_tpu_quantized; "auto" =
+    # quantize weights on TPU only, "on" = everywhere (CPU runs the jnp
+    # dequant oracle — same math, what parity tests use), "off" = dense
+    quantized: Any = None
 
     def __post_init__(self):
         assert self.remat_policy in ("full", "dots"), \
@@ -81,6 +86,9 @@ class LlamaConfig:
         assert self.fused_blocks in (None, "auto", "on", "off"), \
             f"fused_blocks must be None, 'auto', 'on' or 'off', got " \
             f"{self.fused_blocks!r}"
+        assert self.quantized in (None, "auto", "on", "off"), \
+            f"quantized must be None, 'auto', 'on' or 'off', got " \
+            f"{self.quantized!r}"
 
     @property
     def head_dim(self):
@@ -223,9 +231,9 @@ def _attention(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
     B, S, H = x.shape
     nh, nkv, d = cfg.num_attention_heads, cfg.num_key_value_heads, \
         cfg.head_dim
-    q = (x @ lp["wq"]).reshape(B, S, nh, d)
-    k = (x @ lp["wk"]).reshape(B, S, nkv, d)
-    v = (x @ lp["wv"]).reshape(B, S, nkv, d)
+    q = _qmm(x, lp["wq"]).reshape(B, S, nh, d)
+    k = _qmm(x, lp["wk"]).reshape(B, S, nkv, d)
+    v = _qmm(x, lp["wv"]).reshape(B, S, nkv, d)
     q = _apply_rope(q, sin, cos)
     k = _apply_rope(k, sin, cos)
     if cp_axis_level:
@@ -249,13 +257,106 @@ def _attention(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
         # flash-attention via Pallas when available; jnp fallback
         from ..ops import pallas_ops
         out = pallas_ops.causal_attention(q, k, v)
-    return out.reshape(B, S, H) @ lp["wo"]
+    return _qmm(out.reshape(B, S, H), lp["wo"])
 
 
 def _dense_mlp(lp, x):
-    gate = jax.nn.silu(x @ lp["w_gate"])
-    up = x @ lp["w_up"]
-    return (gate * up) @ lp["w_down"]
+    gate = jax.nn.silu(_qmm(x, lp["w_gate"]))
+    up = _qmm(x, lp["w_up"])
+    return _qmm(gate * up, lp["w_down"])
+
+
+# ---------------------------------------------------------------------------
+# int8 weight path (serving): quantize_params + _qmm dispatch
+# ---------------------------------------------------------------------------
+
+def _qmm(x, w):
+    """x @ w where ``w`` is either a dense array or a quantize_params
+    leaf ``{"q": int8 [K, N], "scale": f32 [1, N]}`` — the int8 leaf
+    routes through ops.pallas_ops.int8_matmul (Pallas kernel on TPU,
+    jnp dequant oracle elsewhere)."""
+    if isinstance(w, dict):
+        from ..ops.pallas_ops import int8_matmul
+        return int8_matmul(x, w["q"], w["scale"])
+    return x @ w
+
+
+def _quantized_mode(cfg: LlamaConfig) -> bool:
+    """Resolved int8-weight policy: cfg.quantized, else
+    FLAGS_tpu_quantized. "auto" engages on TPU only (CPU keeps dense
+    weights — the jnp oracle exists for parity, not speed); "on"
+    quantizes everywhere including CPU (what parity tests use); "off"
+    never quantizes."""
+    from ..ops import pallas_ops
+    mode = cfg.quantized
+    if mode is None:
+        try:
+            from ..core.flags import flag
+            mode = flag("FLAGS_tpu_quantized")
+        except Exception:
+            mode = "auto"
+    if mode == "off":
+        return False
+    if mode == "auto" and not pallas_ops._on_tpu():
+        return False
+    return True
+
+
+# weight leaves quantize_params converts (per-layer stacked [L, K, N]);
+# norms, embed and the MoE expert einsum weights stay dense
+_QUANT_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _note_quant_err(name, w, q, scale):
+    """Numerics-watchdog gauges (satellite of the int8 path): rms +
+    absmax of (dequant - reference) per weight, plus the worst layer
+    index for stacked weights — so a bad scale is localized like a NaN.
+    All behind FLAGS_tpu_check_nan_inf; zero cost when off."""
+    from ..profiler import numerics
+    if not numerics.enabled():
+        return
+    wf = np.asarray(jax.device_get(w)).astype(np.float32)
+    deq = (np.asarray(jax.device_get(q)).astype(np.float32)
+           * np.asarray(jax.device_get(scale)).astype(np.float32))
+    err = deq - wf
+    if err.size == 0:
+        return
+    numerics.note(f"quant_err_rms_{name}",
+                  float(np.sqrt(np.mean(err * err))))
+    numerics.note(f"quant_err_absmax_{name}", float(np.max(np.abs(err))))
+    if err.ndim == 3:  # stacked [L, K, N]: localize the worst layer
+        per_layer = np.max(np.abs(err), axis=(1, 2))
+        numerics.note(f"quant_err_worst_layer_{name}",
+                      float(np.argmax(per_layer)))
+
+
+def quantize_params(cfg: LlamaConfig, params):
+    """PTQ the serving weight path to int8: each matmul weight in
+    _QUANT_WEIGHTS (stacked [L, K, N]) plus lm_head becomes a
+    ``{"q": int8, "scale": f32}`` leaf via per-output-channel absmax
+    (ops.pallas_ops.quantize_int8). lax.scan slices dict leaves along
+    the leading L axis like any pytree, so forward bodies see per-layer
+    ``{"q": [K, N], "scale": [1, N]}`` and dispatch through _qmm.
+    Dense configs only — MoE expert weights ride einsums and stay
+    dense. Idempotent (already-quantized leaves pass through)."""
+    from ..ops.pallas_ops import quantize_int8
+    out = dict(params)
+    layers = dict(params["layers"])
+    if cfg.moe_num_experts == 0:
+        for nm in _QUANT_WEIGHTS:
+            w = layers.get(nm)
+            if w is None or isinstance(w, dict):
+                continue
+            q, scale = quantize_int8(w)
+            layers[nm] = {"q": q, "scale": scale}
+            _note_quant_err(nm, w, q, scale)
+    out["layers"] = layers
+    head = out.get("lm_head")
+    if head is not None and not isinstance(head, dict):
+        q, scale = quantize_int8(head)
+        out["lm_head"] = {"q": q, "scale": scale}
+        _note_quant_err("lm_head", head, q, scale)
+    return out
 
 
 def _moe_mlp(cfg: LlamaConfig, lp, x):
@@ -335,6 +436,11 @@ def decoder_layer(cfg: LlamaConfig, lp, x, sin, cos, cp_mesh=None,
     from ..ops import pallas_ops
     fused_attn, fused_mlp = _fused_block_modes(cfg, x, cp_mesh,
                                                cp_axis_level)
+    if isinstance(lp.get("wq"), dict) or isinstance(lp.get("w_gate"), dict):
+        # int8 quantize_params leaves: the fused-block kernels take dense
+        # weight refs, so quantized layers always use the unfused
+        # composition (whose matmuls dispatch through _qmm)
+        fused_attn = fused_mlp = False
     if fused_attn:
         # norm + qkv + rope + flash + wo + residual in two Pallas kernels
         h = pallas_ops.fused_attention_block(
@@ -414,7 +520,7 @@ def forward_pure(cfg: LlamaConfig, params, input_ids, sp_axis=None,
                              cp_mesh=cp_mesh, cp_axis=cp_axis,
                              grad_sync_axis=grad_sync_axis)
     x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, aux
 
 
@@ -462,11 +568,11 @@ def forward_with_cache(cfg: LlamaConfig, params, tokens, cache, pos):
     def body(h, inp):
         lp, ck, cv = inp
         xn = _rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
-        q = _apply_rope((xn @ lp["wq"]).reshape(B, T, nh, d), sin, cos)
-        k = _apply_rope((xn @ lp["wk"]).reshape(B, T, nkv, d), sin, cos)
-        v = (xn @ lp["wv"]).reshape(B, T, nkv, d)
+        q = _apply_rope(_qmm(xn, lp["wq"]).reshape(B, T, nh, d), sin, cos)
+        k = _apply_rope(_qmm(xn, lp["wk"]).reshape(B, T, nkv, d), sin, cos)
+        v = _qmm(xn, lp["wv"]).reshape(B, T, nkv, d)
         out, ck, cv = cached_attention_core(q, k, v, ck, cv, pos)
-        h = h + out.reshape(B, T, H) @ lp["wo"]
+        h = h + _qmm(out.reshape(B, T, H), lp["wo"])
         hn = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
         if cfg.moe_num_experts > 0:
             mlp_out, _aux = _moe_mlp(cfg, lp, hn)
@@ -478,12 +584,13 @@ def forward_with_cache(cfg: LlamaConfig, params, tokens, cache, pos):
     x, (new_k, new_v) = lax.scan(body, x,
                                  (params["layers"], cache.k, cache.v))
     x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _qmm(x, params["lm_head"]).astype(jnp.float32)
     return logits, KVCache(new_k, new_v)
 
 
 def forward_paged(cfg: LlamaConfig, params, tokens, k_pages, v_pages,
-                  block_tables, seq_lens, q_lens):
+                  block_tables, seq_lens, q_lens, *,
+                  k_scales=None, v_scales=None):
     """Ragged mixed prefill+decode forward over a paged KV cache (the
     serving engine's step function).
 
@@ -495,14 +602,37 @@ def forward_paged(cfg: LlamaConfig, params, tokens, k_pages, v_pages,
                                   page, absorbs padding-token scatters)
     seq_lens      [R] i32         total kv length incl. this chunk
     q_lens        [R] i32         chunk lengths (0 = inactive slot)
+    k/v_scales    [L, nkv, P] f32 per-page dequant scales — presence
+                                  selects the quantized-KV path: pools
+                                  hold int8 pages, new k/v are
+                                  quantize-on-write requantized per
+                                  page, and attention dequants on read
 
     Fixed shapes throughout — one compilation per (R, Tc, pool)
     signature.  Rope runs at each token's absolute position
     (seq_lens - q_lens + t), new k/v are scattered through the block
     table, and attention is ``ops.pallas_ops.ragged_paged_attention``
     (jnp reference off-TPU).  Returns (logits [R, Tc, V] fp32,
-    (k_pages, v_pages)); logits in padding rows are garbage by
-    contract — callers read row q_lens[r] - 1."""
+    (k_pages, v_pages)) — with scales, (k_pages, v_pages, k_scales,
+    v_scales); logits in padding rows are garbage by contract —
+    callers read row q_lens[r] - 1.
+
+    Quantized-KV write path: a per-request window of W logical blocks
+    starting at the chunk's first page is gathered, dequantized,
+    updated with the chunk's new tokens, re-scaled per page (absmax /
+    127) and requantized back.  Window positions at/beyond seq_len are
+    zero-masked before the rescale, so a recycled page's previous
+    content can never leak into the new owner's page scale — writes
+    are a pure function of the request's own tokens, which keeps
+    replay after preemption and prefix-cache reuse deterministic.
+    Requantization is exact for untouched tokens while the page scale
+    is unchanged (dequant of q*s is lossless and the absmax token
+    requants to ±127), but a page written under a different chunking
+    schedule can differ in the last int8 bit — quantized streams are
+    parity-within-tolerance, not bit-identical (docs/serving.md).
+    Window slots whose block-table entry is 0 (unallocated → the
+    reserved null page) are dropped from the scatter, keeping the
+    null page zero."""
     from ..ops.pallas_ops import ragged_paged_attention
 
     R, Tc = tokens.shape
@@ -537,42 +667,110 @@ def forward_paged(cfg: LlamaConfig, params, tokens, k_pages, v_pages,
     phys = jnp.take_along_axis(block_tables, blk, axis=1)  # [R, Tc]
     dest = jnp.where(valid, phys * page + qpos_c % page, 0).reshape(-1)
 
+    quant_kv = k_scales is not None
+    if quant_kv:
+        # R-M-W window per request: W logical blocks from the chunk's
+        # first page (covers Tc tokens straddling page boundaries)
+        Bmax = block_tables.shape[1]
+        W = Tc // page + 2
+        first_blk = (jnp.maximum(start, 0) // page).astype(jnp.int32)
+        wblk = first_blk[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        wvalid = (wblk < Bmax) & (q_lens > 0)[:, None]         # [R, W]
+        phys_w = jnp.take_along_axis(
+            block_tables, jnp.clip(wblk, 0, Bmax - 1), axis=1)
+        wvalid = wvalid & (phys_w > 0)   # never write the null page
+        flat_w = phys_w.reshape(-1)                            # [R*W]
+        # OOB sentinel + mode="drop" discards invalid window slots
+        scatter_pg = jnp.where(wvalid.reshape(-1), flat_w, num_pages)
+        rel = qpos - (first_blk * page)[:, None]               # [R, Tc]
+        rel_c = jnp.where(valid, rel, W * page)                # OOB drop
+        rows = jnp.broadcast_to(
+            jnp.arange(R, dtype=jnp.int32)[:, None], (R, Tc))
+        # window positions at/beyond seq_len hold garbage (recycled
+        # pages keep their previous owner's bytes); zero them so the
+        # page absmax — and therefore every written byte — depends
+        # only on this request's own tokens
+        wpos = (first_blk * page)[:, None] \
+            + jnp.arange(W * page, dtype=jnp.int32)[None, :]   # [R,W*page]
+        live = wpos < seq_lens[:, None]
+
+        def quant_write(pool, scales, new_t):
+            # pool [nkv, P, page, d] int8 · scales [nkv, P] f32 ·
+            # new_t [nkv, R, Tc, d] f32 — gather window, dequant,
+            # insert new tokens, per-page absmax rescale, requantize
+            win = jnp.take(pool, flat_w, axis=1).astype(jnp.float32)
+            sc = jnp.take(scales, flat_w, axis=1)              # [nkv,R*W]
+            deq = (win * sc[:, :, None, None]).reshape(
+                nkv, R, W * page, d)
+            deq = deq.at[:, rows, rel_c].set(new_t, mode="drop")
+            deq = jnp.where(live[None, :, :, None], deq, 0.0)
+            wp = deq.reshape(nkv, R, W, page, d)
+            amax = jnp.max(jnp.abs(wp), axis=(3, 4))           # [nkv,R,W]
+            new_sc = jnp.maximum(amax, 1e-8) / 127.0
+            qp = jnp.clip(jnp.round(wp / new_sc[..., None, None]),
+                          -127, 127).astype(pool.dtype)
+            pool = pool.at[:, scatter_pg].set(
+                qp.reshape(nkv, R * W, page, d), mode="drop")
+            scales = scales.at[:, scatter_pg].set(
+                new_sc.reshape(nkv, R * W), mode="drop")
+            return pool, scales
+
     x = jnp.take(params["embed"], tokens, axis=0)
 
     def body(h, inp):
-        lp, kp, vp = inp
+        if quant_kv:
+            lp, kp, vp, ks, vs = inp
+        else:
+            lp, kp, vp = inp
+            ks = vs = None
         xn = _rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
-        q = rope((xn @ lp["wq"]).reshape(R, Tc, nh, d))
-        k = rope((xn @ lp["wk"]).reshape(R, Tc, nkv, d))
-        v = (xn @ lp["wv"]).reshape(R, Tc, nkv, d)
-        # scatter new k/v: [R, Tc, nkv, d] -> [nkv, R*Tc, d] at dest
-        k_t = k.transpose(2, 0, 1, 3).reshape(nkv, R * Tc, d)
-        v_t = v.transpose(2, 0, 1, 3).reshape(nkv, R * Tc, d)
-        kp = kp.reshape(nkv, num_pages * page, d).at[:, dest].set(
-            k_t.astype(kp.dtype)).reshape(nkv, num_pages, page, d)
-        vp = vp.reshape(nkv, num_pages * page, d).at[:, dest].set(
-            v_t.astype(vp.dtype)).reshape(nkv, num_pages, page, d)
+        q = rope(_qmm(xn, lp["wq"]).reshape(R, Tc, nh, d))
+        k = rope(_qmm(xn, lp["wk"]).reshape(R, Tc, nkv, d))
+        v = _qmm(xn, lp["wv"]).reshape(R, Tc, nkv, d)
+        if quant_kv:
+            kp, ks = quant_write(
+                kp, ks, k.transpose(2, 0, 1, 3).astype(jnp.float32))
+            vp, vs = quant_write(
+                vp, vs, v.transpose(2, 0, 1, 3).astype(jnp.float32))
+        else:
+            # scatter new k/v: [R, Tc, nkv, d] -> [nkv, R*Tc, d] at dest
+            k_t = k.transpose(2, 0, 1, 3).reshape(nkv, R * Tc, d)
+            v_t = v.transpose(2, 0, 1, 3).reshape(nkv, R * Tc, d)
+            kp = kp.reshape(nkv, num_pages * page, d).at[:, dest].set(
+                k_t.astype(kp.dtype)).reshape(nkv, num_pages, page, d)
+            vp = vp.reshape(nkv, num_pages * page, d).at[:, dest].set(
+                v_t.astype(vp.dtype)).reshape(nkv, num_pages, page, d)
         # kernel layout [R, nkv, Tc*rep, d]: row t*rep + j = q head
         # k*rep + j of token t (the h // rep GQA mapping)
         qk = q.reshape(R, Tc, nkv, rep, d).transpose(
             0, 2, 1, 3, 4).reshape(R, nkv, Tc * rep, d)
         out = ragged_paged_attention(qk, kp, vp, block_tables,
-                                     seq_lens, q_lens, rep=rep)
+                                     seq_lens, q_lens, rep=rep,
+                                     k_scales=ks, v_scales=vs)
         out = out.reshape(R, nkv, Tc, rep, d).transpose(
             0, 2, 1, 3, 4).reshape(R, Tc, H)
-        h = h + out.astype(h.dtype) @ lp["wo"]
+        h = h + _qmm(out.astype(h.dtype), lp["wo"])
         hn = _rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
         if cfg.moe_num_experts > 0:
             mlp_out, _aux = _moe_mlp(cfg, lp, hn)
             h = h + mlp_out
         else:
             h = h + _dense_mlp(lp, hn)
+        if quant_kv:
+            return h, (kp, vp, ks, vs)
         return h, (kp, vp)
 
-    x, (new_k, new_v) = lax.scan(body, x,
-                                 (params["layers"], k_pages, v_pages))
+    if quant_kv:
+        x, (new_k, new_v, new_ks, new_vs) = lax.scan(
+            body, x, (params["layers"], k_pages, v_pages,
+                      k_scales, v_scales))
+    else:
+        x, (new_k, new_v) = lax.scan(body, x,
+                                     (params["layers"], k_pages, v_pages))
     x = _rms_norm(x, params["norm_f"], cfg.rms_norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _qmm(x, params["lm_head"]).astype(jnp.float32)
+    if quant_kv:
+        return logits, (new_k, new_v, new_ks, new_vs)
     return logits, (new_k, new_v)
 
 

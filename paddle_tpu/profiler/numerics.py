@@ -463,12 +463,23 @@ def summary_lines() -> List[str]:
         site_items = sorted(_SITES.items())
         stats = dict(_LAST)
         n_collected = len(_COLLECTED)
+    # quantization-error gauges (quant_err_* from quantize_params /
+    # convert_to_mixed_precision) group under their own sub-block so a
+    # bad scale is localized like a NaN
+    quant = [k for k in sorted(stats) if k.startswith("quant_err_")]
     shown = [k for k in _STAT_ORDER if k in stats]
-    shown += [k for k in sorted(stats) if k not in _STAT_ORDER]
+    shown += [k for k in sorted(stats)
+              if k not in _STAT_ORDER and k not in quant]
     for k in shown:
         v = stats[k]
         mark = "  <-- NON-FINITE" if not math.isfinite(v) else ""
         lines.append(f"  {k:<28} {v:.6g}{mark}")
+    if quant:
+        lines.append("  Quantization")
+        for k in quant:
+            v = stats[k]
+            mark = "  <-- NON-FINITE" if not math.isfinite(v) else ""
+            lines.append(f"    {k:<28} {v:.6g}{mark}")
     if site_items:
         lines.append(f"  check sites: {len(site_items)}")
         for nm, s in site_items[:10]:

@@ -34,8 +34,8 @@ from .engine import (LLMEngine, SLOConfig, reset_stats,  # noqa: F401
 from .errors import (AdmissionRejected, DeadlineExceeded,  # noqa: F401
                      ReplicaUnavailable, RequestQuarantined,
                      RetriableError, ServingError)
-from .kv_cache import (BlockAllocator, PagedKVCache,  # noqa: F401
-                       kv_bytes_per_token, plan_capacity)
+from .kv_cache import (KV_DTYPE_BYTES, BlockAllocator,  # noqa: F401
+                       PagedKVCache, kv_bytes_per_token, plan_capacity)
 from .prefix_cache import PrefixCache, PrefixStats  # noqa: F401
 from .router import (EngineReplica, ReplicaState, Router,  # noqa: F401
                      RouterRequest)
@@ -47,7 +47,8 @@ from .spec_decode import (DraftModel, SpecDecodeConfig,  # noqa: F401
 __all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
            "summary_lines",
            "BlockAllocator", "PagedKVCache", "kv_bytes_per_token",
-           "plan_capacity", "Request", "RequestState", "Scheduler",
+           "plan_capacity", "KV_DTYPE_BYTES",
+           "Request", "RequestState", "Scheduler",
            "StepPlan", "ScheduledSeq",
            "PrefixCache", "PrefixStats",
            "SpecDecodeConfig", "DraftModel", "greedy_accept",

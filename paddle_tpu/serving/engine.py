@@ -227,6 +227,11 @@ class LLMEngine:
         from ..models import llama as _llama
 
         self.cfg = cfg
+        if _llama._quantized_mode(cfg):
+            # int8 weight path (cfg.quantized / FLAGS_tpu_quantized):
+            # PTQ the serving weights once at engine build; forward
+            # bodies dispatch through the int8 matmul kernels
+            params = _llama.quantize_params(cfg, params)
         self.params = params
         self._forward_paged = _llama.forward_paged
         self.max_running = int(max_running)
@@ -261,20 +266,39 @@ class LLMEngine:
                                    max_model_len=self.max_model_len)
 
         kv_dtype = kv_dtype or cfg.dtype
+        if isinstance(kv_dtype, str):
+            kv_dtype = {"bf16": jnp.bfloat16,
+                        "int8": jnp.int8}.get(kv_dtype, kv_dtype)
         L, nkv, d = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                      cfg.head_dim)
         self._kv_dtype = kv_dtype
+        # int8 pages select the quantized-KV path: a parallel per-page
+        # scale pool (f32 [L, nkv, P], indexed by the same block
+        # tables) rides every step — quantize-on-write in
+        # forward_paged, dequant-on-read inside ragged_paged_attention
+        self._quant_kv = jnp.dtype(kv_dtype) == jnp.dtype(jnp.int8)
         self._pool_shape = (L, nkv, self.num_pages, self.page_size, d)
+        self._scale_shape = (L, nkv, self.num_pages)
         self._kp = jnp.zeros(self._pool_shape, kv_dtype)
         self._vp = jnp.zeros(self._pool_shape, kv_dtype)
+        self._ks = self._vs = None
+        scale_bytes = 0
+        if self._quant_kv:
+            # scale 1.0 everywhere: untouched (all-zero) pages dequant
+            # to exact zeros, matching the dense pools' init state
+            self._ks = jnp.ones(self._scale_shape, jnp.float32)
+            self._vs = jnp.ones(self._scale_shape, jnp.float32)
+            scale_bytes = 2 * int(np.prod(self._scale_shape)) * 4
         pool_bytes = (2 * int(np.prod(self._pool_shape))
-                      * jnp.dtype(kv_dtype).itemsize)
+                      * jnp.dtype(kv_dtype).itemsize) + scale_bytes
         _xmem.record_reservation(
             "serving.kv_pages", pool_bytes, pages=self.num_pages,
-            page_size=self.page_size,
+            page_size=self.page_size, kv_dtype=str(jnp.dtype(kv_dtype)),
+            scale_pool_bytes=scale_bytes,
             bytes_per_token=kv_bytes_per_token(
                 cfg, jnp.dtype(kv_dtype).itemsize))
         self._pool_bytes = pool_bytes
+        self._scale_bytes = scale_bytes
 
         if donate_pools is None:
             donate_pools = jax.default_backend() in ("tpu", "axon")
@@ -406,9 +430,7 @@ class LLMEngine:
             return fn
         cfg, fwd = self.cfg, self._forward_paged
 
-        def step(params, tokens, kp, vp, tbl, lens, qlens):
-            logits, (kp, vp) = fwd(cfg, params, tokens, kp, vp, tbl,
-                                   lens, qlens)
+        def _sample(logits, tokens, qlens):
             last = jnp.clip(qlens - 1, 0, tokens.shape[1] - 1)
             rows = jnp.take_along_axis(
                 logits, last[:, None, None], axis=1)[:, 0]   # [R, V]
@@ -420,9 +442,27 @@ class LLMEngine:
             # chk: one float per row (max logit) — a cheap [R] transfer
             # the numerics watchdog scans for NaN/Inf poisoning
             return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                    jnp.max(rows, axis=-1), kp, vp)
+                    jnp.max(rows, axis=-1))
 
-        fn = jax.jit(step, donate_argnums=(2, 3) if self._donate else ())
+        if self._quant_kv:
+            def step(params, tokens, kp, vp, ks, vs, tbl, lens, qlens):
+                logits, (kp, vp, ks, vs) = fwd(
+                    cfg, params, tokens, kp, vp, tbl, lens, qlens,
+                    k_scales=ks, v_scales=vs)
+                nxt, chk = _sample(logits, tokens, qlens)
+                return nxt, chk, kp, vp, ks, vs
+
+            fn = jax.jit(step, donate_argnums=(
+                (2, 3, 4, 5) if self._donate else ()))
+        else:
+            def step(params, tokens, kp, vp, tbl, lens, qlens):
+                logits, (kp, vp) = fwd(cfg, params, tokens, kp, vp, tbl,
+                                       lens, qlens)
+                nxt, chk = _sample(logits, tokens, qlens)
+                return nxt, chk, kp, vp
+
+            fn = jax.jit(step, donate_argnums=(
+                (2, 3) if self._donate else ()))
         self._step_fns[Tc] = fn
         _STATS["compiled_buckets"] += 1
         return fn
@@ -456,15 +496,32 @@ class LLMEngine:
         so a donated page always carries both models' kv.  One compile:
         src/dst are traced scalars, not baked constants."""
         if self._copy_fn is None:
-            def cp(kp, vp, s, d):
-                return (kp.at[:, :, d].set(kp[:, :, s]),
-                        vp.at[:, :, d].set(vp[:, :, s]))
+            if self._quant_kv:
+                def cp(kp, vp, ks, vs, s, d):
+                    # a COW fork copies the page AND its dequant scale
+                    return (kp.at[:, :, d].set(kp[:, :, s]),
+                            vp.at[:, :, d].set(vp[:, :, s]),
+                            ks.at[:, :, d].set(ks[:, :, s]),
+                            vs.at[:, :, d].set(vs[:, :, s]))
 
-            self._copy_fn = jax.jit(
-                cp, donate_argnums=(0, 1) if self._donate else ())
+                self._copy_fn = jax.jit(
+                    cp, donate_argnums=(
+                        (0, 1, 2, 3) if self._donate else ()))
+            else:
+                def cp(kp, vp, s, d):
+                    return (kp.at[:, :, d].set(kp[:, :, s]),
+                            vp.at[:, :, d].set(vp[:, :, s]))
+
+                self._copy_fn = jax.jit(
+                    cp, donate_argnums=(0, 1) if self._donate else ())
         for src, dst in pairs:
-            self._kp, self._vp = self._copy_fn(
-                self._kp, self._vp, jnp.int32(src), jnp.int32(dst))
+            if self._quant_kv:
+                self._kp, self._vp, self._ks, self._vs = self._copy_fn(
+                    self._kp, self._vp, self._ks, self._vs,
+                    jnp.int32(src), jnp.int32(dst))
+            else:
+                self._kp, self._vp = self._copy_fn(
+                    self._kp, self._vp, jnp.int32(src), jnp.int32(dst))
             if self._draft is not None:
                 self._draft.copy_page(src, dst)
 
@@ -694,9 +751,17 @@ class LLMEngine:
             chaos_point("serve.step", step=self._steps,
                         rids=[s.request.rid for s in plan.seqs],
                         pool=self.kv.allocator, engine=self)
-            nxt, chk, self._kp, self._vp = self._step_fn(Tc)(
-                self.params, jnp.asarray(tokens), self._kp, self._vp,
-                jnp.asarray(tbl), jnp.asarray(lens), jnp.asarray(qlens))
+            if self._quant_kv:
+                (nxt, chk, self._kp, self._vp, self._ks,
+                 self._vs) = self._step_fn(Tc)(
+                    self.params, jnp.asarray(tokens), self._kp,
+                    self._vp, self._ks, self._vs, jnp.asarray(tbl),
+                    jnp.asarray(lens), jnp.asarray(qlens))
+            else:
+                nxt, chk, self._kp, self._vp = self._step_fn(Tc)(
+                    self.params, jnp.asarray(tokens), self._kp,
+                    self._vp, jnp.asarray(tbl), jnp.asarray(lens),
+                    jnp.asarray(qlens))
             nxt = np.asarray(nxt)
             if _numerics.enabled():
                 rows = np.asarray(chk)[[s.slot for s in plan.seqs]]
@@ -746,6 +811,9 @@ class LLMEngine:
         self.scheduler.kv = self.kv
         self._kp = jnp.zeros(self._pool_shape, self._kv_dtype)
         self._vp = jnp.zeros(self._pool_shape, self._kv_dtype)
+        if self._quant_kv:
+            self._ks = jnp.ones(self._scale_shape, jnp.float32)
+            self._vs = jnp.ones(self._scale_shape, jnp.float32)
         if self._draft is not None:
             self._draft.reset()
         demoted = self.scheduler.reset_running()
@@ -777,12 +845,22 @@ class LLMEngine:
             chaos_point("serve.step", step=self._steps,
                         rids=[r.rid for r in group],
                         pool=kv.allocator, engine=self, probe=True)
-            _, chk, _, _ = self._step_fn(Tc)(
-                self.params, jnp.asarray(tokens),
-                jnp.zeros(self._pool_shape, self._kv_dtype),
-                jnp.zeros(self._pool_shape, self._kv_dtype),
-                jnp.asarray(tbl), jnp.asarray(lens),
-                jnp.asarray(qlens))
+            if self._quant_kv:
+                _, chk, *_rest = self._step_fn(Tc)(
+                    self.params, jnp.asarray(tokens),
+                    jnp.zeros(self._pool_shape, self._kv_dtype),
+                    jnp.zeros(self._pool_shape, self._kv_dtype),
+                    jnp.ones(self._scale_shape, jnp.float32),
+                    jnp.ones(self._scale_shape, jnp.float32),
+                    jnp.asarray(tbl), jnp.asarray(lens),
+                    jnp.asarray(qlens))
+            else:
+                _, chk, _, _ = self._step_fn(Tc)(
+                    self.params, jnp.asarray(tokens),
+                    jnp.zeros(self._pool_shape, self._kv_dtype),
+                    jnp.zeros(self._pool_shape, self._kv_dtype),
+                    jnp.asarray(tbl), jnp.asarray(lens),
+                    jnp.asarray(qlens))
             if _numerics.enabled():
                 rows = np.asarray(chk)[[s.slot for s in seqs]]
                 _numerics.check_array(rows, "serve.step.probe",
@@ -930,7 +1008,7 @@ class LLMEngine:
         """Drop the pools and their xmem reservation."""
         _STATS["pool_bytes"] -= self._pool_bytes
         _xmem.record_reservation("serving.kv_pages", 0)
-        self._kp = self._vp = None
+        self._kp = self._vp = self._ks = self._vs = None
         self._step_fns.clear()
         self._copy_fn = None
         if self._draft is not None:

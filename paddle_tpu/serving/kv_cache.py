@@ -347,20 +347,41 @@ def _param_count(cfg) -> int:
             + cfg.num_hidden_layers * per_layer + H)
 
 
+#: --kv-dtype axis of the capacity plan: page itemsize in bytes
+KV_DTYPE_BYTES = {"bf16": 2, "fp16": 2, "int8": 1, "fp8": 1}
+
+
 def plan_capacity(cfg, *, hbm_bytes: int, page_size: int = 128,
                   max_model_len: Optional[int] = None,
+                  kv_dtype: Optional[str] = None,
                   kv_dtype_bytes: int = 2, weights_dtype_bytes: int = 2,
                   headroom_fraction: float = 0.10,
                   runtime_bytes: int = 0) -> dict:
     """HBM budget for one chip: how many pool pages fit after weights,
     and how many concurrent max-length requests that sustains.  Pure
     arithmetic — safe on a CPU-only host, used by pod_report's
-    ``serving`` section and by the engine's default pool sizing."""
+    ``serving`` section and by the engine's default pool sizing.
+
+    ``kv_dtype`` ("bf16"/"int8"/...) overrides ``kv_dtype_bytes`` and,
+    for sub-2-byte pages, adds the quantized-KV path's per-page scale
+    overhead: two f32 scales per (layer, kv head, page) — the parallel
+    scale pools the engine allocates next to int8 page pools."""
     max_len = int(max_model_len or cfg.max_position_embeddings)
+    if kv_dtype is not None:
+        if kv_dtype not in KV_DTYPE_BYTES:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}; "
+                             f"choose from {sorted(KV_DTYPE_BYTES)}")
+        kv_dtype_bytes = KV_DTYPE_BYTES[kv_dtype]
     weights = _param_count(cfg) * weights_dtype_bytes
     usable = int(hbm_bytes * (1.0 - headroom_fraction)) - weights \
         - int(runtime_bytes)
     page_bytes = kv_bytes_per_token(cfg, kv_dtype_bytes) * page_size
+    scale_bytes_per_page = 0
+    if kv_dtype_bytes < 2:
+        # k + v scale-pool entries across layers, f32 each
+        scale_bytes_per_page = 2 * cfg.num_hidden_layers \
+            * cfg.num_key_value_heads * 4
+        page_bytes += scale_bytes_per_page
     num_pages = max(usable // page_bytes, 0)
     blocks_per_req = _cdiv(max_len, page_size)
     max_concurrent = (num_pages - 1) // blocks_per_req \
@@ -371,6 +392,8 @@ def plan_capacity(cfg, *, hbm_bytes: int, page_size: int = 128,
         "usable_kv_bytes": max(int(usable), 0),
         "page_size": int(page_size),
         "page_bytes": int(page_bytes),
+        "kv_dtype": kv_dtype or f"{kv_dtype_bytes}B",
+        "scale_bytes_per_page": int(scale_bytes_per_page),
         "num_pages": int(num_pages),
         "kv_bytes_per_token": kv_bytes_per_token(cfg, kv_dtype_bytes),
         "max_model_len": max_len,

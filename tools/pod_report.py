@@ -55,6 +55,11 @@ def parse_mesh(spec: str):
 
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("mode", nargs="?", default=None, choices=["serving"],
+                    help="'serving' emits only the serving capacity "
+                         "section (hardware-free arithmetic, no train-"
+                         "step compile — seconds instead of minutes); "
+                         "omit for the full pod-fit report")
     ap.add_argument("--preset", default="llama7b",
                     help="model preset from models.llama.PRESETS")
     ap.add_argument("--mesh", default="v5p-64",
@@ -65,6 +70,13 @@ def _parse_args(argv=None):
     ap.add_argument("--page-size", type=int, default=128,
                     help="paged-KV tokens per pool page for the "
                          "serving capacity section")
+    ap.add_argument("--kv-dtype", default="bf16",
+                    choices=["bf16", "fp16", "int8", "fp8"],
+                    help="paged-KV page dtype for the serving capacity "
+                         "section; sub-2-byte dtypes include the "
+                         "quantized-KV per-page scale-pool overhead "
+                         "and report the capacity ratio vs the bf16 "
+                         "baseline")
     ap.add_argument("--replicas", type=int, default=1,
                     help="engine replicas behind a serving.Router — "
                          "the serving section reports router-level "
@@ -379,12 +391,28 @@ def _serving_section(cfg, gen, args):
         return None
     hbm = int(gen["hbm_gib"] * 2**30)
     seq = args.seq or cfg.max_position_embeddings
+    kv_dtype = getattr(args, "kv_dtype", None) or "bf16"
     plan = plan_capacity(cfg, hbm_bytes=hbm,
                          page_size=int(args.page_size),
-                         max_model_len=seq)
+                         max_model_len=seq, kv_dtype=kv_dtype)
     plan["weights_gib"] = round(plan["weights_bytes"] / 2**30, 2)
     plan["usable_kv_gib"] = round(plan["usable_kv_bytes"] / 2**30, 2)
     plan["fits"] = plan["max_concurrent_requests"] > 0
+    if kv_dtype != "bf16":
+        # the --kv-dtype axis: same chip, same weights, only the page
+        # format changes — the predicted capacity win of quantized KV
+        base = plan_capacity(cfg, hbm_bytes=hbm,
+                             page_size=int(args.page_size),
+                             max_model_len=seq, kv_dtype="bf16")
+        plan["baseline_bf16"] = {
+            "num_pages": base["num_pages"],
+            "page_bytes": base["page_bytes"],
+            "max_concurrent_requests": base["max_concurrent_requests"],
+        }
+        if base["max_concurrent_requests"] > 0:
+            plan["capacity_ratio_vs_bf16"] = round(
+                plan["max_concurrent_requests"]
+                / base["max_concurrent_requests"], 3)
     # measured prefix-hit-rate folds into capacity: a hit fraction h
     # means h of each request's pages come from the radix cache and
     # are shared, so only (1-h) of blocks_per_request are unique per
@@ -414,6 +442,24 @@ def _serving_section(cfg, gen, args):
         "usable_kv_bytes": n * plan["usable_kv_bytes"],
     }
     return plan
+
+
+def build_serving_report(args):
+    """The ``serving`` subcommand: just the capacity arithmetic —
+    plan_capacity over the --kv-dtype axis, no train-step compile, so
+    it answers "how many concurrent requests per chip" in seconds."""
+    gen_name, n_dev = parse_mesh(args.mesh)
+    gen = TPU_GENERATIONS[gen_name]
+    from paddle_tpu.models import llama
+    cfg = llama.preset(args.preset)
+    return {
+        "mode": "serving",
+        "preset": args.preset,
+        "mesh": args.mesh,
+        "generation": {"name": gen_name,
+                       "hbm_gib_per_chip": gen["hbm_gib"]},
+        "serving": _serving_section(cfg, gen, args),
+    }
 
 
 def _plan_notes(n_dev):
@@ -481,6 +527,17 @@ def main(argv=None):
     if args.list_presets:
         from paddle_tpu.models.llama import PRESETS
         print("\n".join(sorted(PRESETS)))
+        return 0
+
+    if args.mode == "serving":
+        report = build_serving_report(args)
+        payload = json.dumps(report, indent=2, sort_keys=False)
+        if args.out == "-":
+            print(payload)
+        else:
+            with open(args.out, "w") as f:
+                f.write(payload + "\n")
+            print(f"wrote {args.out}", file=sys.stderr)
         return 0
 
     report = build_report(args)
