@@ -35,6 +35,15 @@ quarantined / deadline-expired counts for the measured run, plus the
 observed-vs-target SLO verdicts) so overload and chaos E2E runs are
 assertable from the one-line contract.
 
+``--workload diurnal|bursty|flash-crowd`` replays the matching seeded
+arrival process from ``serving.workloads`` (the same streams
+``tools/fleet_sim.py`` simulates), mapped onto engine steps so bursts
+land as bursts.  Every run's JSON line carries a ``fleet`` block: the
+per-replica service model calibrated from this run's measured step
+wall-times (prefill-chunk / decode step costs, concurrency, predicted
+capacity rps/chip, and the min-chips answer for the offered load) —
+the live side of the fleet simulator's planning arithmetic.
+
 ``--kv-dtype int8`` (or _KV_DTYPE=int8) serves the same workload over
 the quantized paged KV cache (int8 pages + per-page f32 scale pools;
 parity-within-tolerance vs the bf16 pools, not bit-identical) and the
@@ -94,6 +103,7 @@ def main():
     from paddle_tpu.core import flags as _flags
     from paddle_tpu.models import llama
     from paddle_tpu import serving
+    from paddle_tpu.serving import workloads as _workloads
 
     _flags.set_flags({"FLAGS_tpu_metrics": True})
     from paddle_tpu.core import compile_cache
@@ -108,9 +118,11 @@ def main():
                               "uniform")
     if "--workload" in sys.argv:
         workload = sys.argv[sys.argv.index("--workload") + 1]
-    if workload not in ("uniform", "shared-prefix"):
-        raise ValueError(f"unknown --workload {workload!r} "
-                         "(uniform | shared-prefix)")
+    # one shared preset catalogue (serving/workloads.py): the error
+    # enumerates every valid preset, and the shaped arrival processes
+    # (diurnal/bursty/flash-crowd) are the exact streams fleet_sim
+    # and pod_report plan against
+    _workloads.validate(workload)
     kv_dtype = os.environ.get("PADDLE_TPU_BENCH_SERVE_KV_DTYPE", "bf16")
     if "--kv-dtype" in sys.argv:
         kv_dtype = sys.argv[sys.argv.index("--kv-dtype") + 1]
@@ -121,9 +133,11 @@ def main():
     if "--trace-out" in sys.argv:
         trace_out = sys.argv[sys.argv.index("--trace-out") + 1]
     from paddle_tpu.profiler import trace as _trace
+    from paddle_tpu.serving import autoscale as _autoscale
     if trace_out:
         _flags.set_flags({"FLAGS_tpu_trace": True})
     shared = workload == "shared-prefix"
+    shaped = workload in ("diurnal", "bursty", "flash-crowd")
     n_req = _env_int("REQUESTS", 16)
     max_prompt = _env_int("PROMPT", 24)
     n_new = _env_int("NEW", 16)
@@ -168,7 +182,20 @@ def main():
                             max_queue=max_queue, slo=slo, **reuse_kw)
 
     rng = np.random.RandomState(0)
-    if shared:
+    arrivals = None
+    if shaped:
+        # the preset's seeded arrival process — the exact stream
+        # tools/fleet_sim.py replays against the simulated fleet, so a
+        # live bench and a sim run disagree only on time, never on
+        # what arrived
+        horizon_s = float(os.environ.get(
+            "PADDLE_TPU_BENCH_SERVE_HORIZON_S", "60"))
+        arrivals = _workloads.generate(
+            workload, n_req, seed=_env_int("SEED", 0),
+            horizon_s=horizon_s, prompt_len=max_prompt,
+            max_new_tokens=n_new, vocab=cfg.vocab_size)
+        prompts = [list(a.prompt) for a in arrivals]
+    elif shared:
         # N requests over M distinct system prompts: the shared head is
         # most of the prompt (the few-shot/system-prompt shape), the
         # tail is per-request
@@ -229,17 +256,31 @@ def main():
         except serving.AdmissionRejected:
             shed_submits += 1
 
-    for p in prompts[:n_req // 2]:
-        _submit(p)
     steps = 0
-    pending = list(prompts[n_req // 2:])
-    while eng.has_work() or pending:
-        if pending and steps % 2 == 1:
-            _submit(pending.pop(0))
-        eng.step()
-        steps += 1
-        if steps > 100000:
-            raise RuntimeError("serve loop did not converge")
+    if shaped:
+        # shaped presets arrive on the preset's own timeline, mapped
+        # onto engine steps (workloads.step_schedule) — bursts land as
+        # bursts instead of being smoothed into one-per-two-steps
+        sched = _workloads.step_schedule(arrivals, max(2 * n_req, 1))
+        last_step = max(sched) if sched else 0
+        while eng.has_work() or steps <= last_step:
+            for a in sched.get(steps, ()):
+                _submit(list(a.prompt))
+            eng.step()
+            steps += 1
+            if steps > 100000:
+                raise RuntimeError("serve loop did not converge")
+    else:
+        for p in prompts[:n_req // 2]:
+            _submit(p)
+        pending = list(prompts[n_req // 2:])
+        while eng.has_work() or pending:
+            if pending and steps % 2 == 1:
+                _submit(pending.pop(0))
+            eng.step()
+            steps += 1
+            if steps > 100000:
+                raise RuntimeError("serve loop did not converge")
     wall_s = time.monotonic() - t_start
 
     stats_now = serving.serving_stats()
@@ -306,6 +347,26 @@ def main():
             "samples": bd["samples"],
         }
 
+    # fleet block: the per-replica service model calibrated from this
+    # run's measured step wall-times (by compiled bucket), plus the
+    # capacity arithmetic fleet_sim and the autoscaler plan with —
+    # predicted rps-per-chip next to the measured trajectory above
+    sm = eng.service_model()
+    mean_prompt = (prompt_tokens // len(reqs)) if reqs else max_prompt
+    cap_rps = sm.capacity_rps(mean_prompt, n_new)
+    offered_rps = (len(rids) + shed_submits) / wall_s if wall_s else 0.0
+    fleet = {
+        "calibrated": sm.calibrated,
+        "prefill_chunk_ms": _ms(sm.prefill_chunk_s),
+        "decode_step_ms": _ms(sm.decode_step_s),
+        "concurrency": sm.concurrency,
+        "capacity_rps_per_chip": round(cap_rps, 3),
+        "offered_rps": round(offered_rps, 3),
+        "min_chips_for_offered": _autoscale.replicas_for(
+            sm, offered_rps, prompt_len=max(mean_prompt, 1),
+            new_tokens=n_new),
+    }
+
     trace_sidecar = None
     if trace_out:
         os.makedirs(trace_out, exist_ok=True)
@@ -329,6 +390,7 @@ def main():
         "max_queue": max_queue,
         "workload": workload,
         "reuse": reuse,
+        "fleet": fleet,
         "resilience": res,
         "tokens": tokens,
         "steps": steps,

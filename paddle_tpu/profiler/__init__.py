@@ -370,6 +370,9 @@ class Profiler:
         from ..serving import engine as _serving
         lines.extend(_serving.summary_lines())
         lines.append("-" * len(header))
+        from ..serving import autoscale as _autoscale
+        lines.extend(_autoscale.fleet_summary_lines())
+        lines.append("-" * len(header))
         if self._step_times:
             lines.append(self.step_info(time_unit))
         return "\n".join(lines)

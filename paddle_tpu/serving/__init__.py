@@ -24,11 +24,22 @@ The three layers, bottom-up:
   * ``errors``    — the typed failure taxonomy callers branch on
                     (``retriable`` or terminal).
 
+Fleet planning rides on top: ``workloads`` (seeded synthetic arrival
+processes shared by bench_serve, pod_report and tools/fleet_sim.py)
+and ``autoscale`` (the per-replica ServiceModel, multi-window SLO
+burn-rate gauges, and the recommend-only AutoscalePolicy the Router
+surfaces).  Both are stdlib-only, like ``stats`` — the jax-free slice
+the discrete-event fleet simulator loads standalone.
+
 The attention primitive underneath is
 ``ops.pallas_ops.ragged_paged_attention`` — one Pallas kernel for the
 whole mixed prefill+decode batch, jnp reference off-TPU.  See
 docs/serving.md and docs/robustness.md ("Serving resilience").
 """
+from . import autoscale, workloads  # noqa: F401
+from .autoscale import (AutoscalePolicy, Recommendation,  # noqa: F401
+                        ServiceModel, fleet_stats, recommend_fleet,
+                        replicas_for, reset_fleet_stats)
 from .engine import (LLMEngine, SLOConfig, reset_stats,  # noqa: F401
                      serving_stats, summary_lines)
 from .errors import (AdmissionRejected, DeadlineExceeded,  # noqa: F401
@@ -39,8 +50,9 @@ from .kv_cache import (KV_DTYPE_BYTES, BlockAllocator,  # noqa: F401
 from .prefix_cache import PrefixCache, PrefixStats  # noqa: F401
 from .router import (EngineReplica, ReplicaState, Router,  # noqa: F401
                      RouterRequest)
-from .scheduler import (Request, RequestState,  # noqa: F401
-                        ScheduledSeq, Scheduler, StepPlan)
+from .scheduler import (AdmissionGate, Request,  # noqa: F401
+                        RequestState, ScheduledSeq, Scheduler,
+                        StepPlan)
 from .spec_decode import (DraftModel, SpecDecodeConfig,  # noqa: F401
                           greedy_accept)
 
@@ -48,8 +60,11 @@ __all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
            "summary_lines",
            "BlockAllocator", "PagedKVCache", "kv_bytes_per_token",
            "plan_capacity", "KV_DTYPE_BYTES",
-           "Request", "RequestState", "Scheduler",
+           "AdmissionGate", "Request", "RequestState", "Scheduler",
            "StepPlan", "ScheduledSeq",
+           "workloads", "autoscale", "AutoscalePolicy",
+           "Recommendation", "ServiceModel", "fleet_stats",
+           "reset_fleet_stats", "recommend_fleet", "replicas_for",
            "PrefixCache", "PrefixStats",
            "SpecDecodeConfig", "DraftModel", "greedy_accept",
            "Router", "RouterRequest", "ReplicaState", "EngineReplica",

@@ -65,46 +65,23 @@ from ..testing.chaos import ChaosError, ReplicaKilled, chaos_point
 from .errors import (AdmissionRejected, DeadlineExceeded,
                      RequestQuarantined)
 from .kv_cache import PagedKVCache, _cdiv, kv_bytes_per_token
-from .scheduler import Request, RequestState, Scheduler, StepPlan
+from .scheduler import (AdmissionGate, Request, RequestState, Scheduler,
+                        StepPlan)
 from .spec_decode import DraftModel, SpecDecodeConfig, greedy_accept
+from . import stats as _stats
 
 __all__ = ["LLMEngine", "SLOConfig", "serving_stats", "reset_stats",
            "summary_lines"]
 
 _LOG = logging.getLogger("paddle_tpu.serving")
 
-# process-wide serving stats (Profiler "Serving" section). Plain dict,
-# updated by every engine in the process; cheap enough to keep
-# unconditionally.
-_STATS: Dict[str, float] = {}
-
-
-def _stats_zero() -> Dict[str, float]:
-    return {
-        "engines": 0, "requests_added": 0, "requests_finished": 0,
-        "requests_preempted": 0, "steps": 0, "prefill_tokens": 0,
-        "decode_tokens": 0, "peak_running": 0, "pool_bytes": 0,
-        "compiled_buckets": 0,
-        # work reuse (prefix cache + speculative decoding)
-        "prefix_hit_tokens": 0, "prefix_evicted_pages": 0,
-        "spec_proposed": 0, "spec_accepted": 0,
-        # resilience counters (this module + serving/router.py)
-        "shed": 0, "admission_waits": 0, "callback_errors": 0,
-        "recoveries": 0, "quarantined": 0, "deadline_expired": 0,
-        "cancelled": 0, "failovers": 0, "replicas_dead": 0, "drains": 0,
-    }
-
-
-_STATS.update(_stats_zero())
-
-
-def serving_stats() -> Dict[str, float]:
-    return dict(_STATS)
-
-
-def reset_stats() -> None:
-    _STATS.clear()
-    _STATS.update(_stats_zero())
+# process-wide serving stats (Profiler "Serving" section).  The dict
+# itself lives in serving/stats.py (stdlib-only, shared with the
+# router and the jax-free fleet tools); this module keeps the public
+# serving_stats/reset_stats names.
+_STATS = _stats.STATS
+serving_stats = _stats.serving_stats
+reset_stats = _stats.reset_stats
 
 
 def summary_lines() -> List[str]:
@@ -250,7 +227,10 @@ class LLMEngine:
                              else 8 * self.max_running)
         self.slo = slo
         self._watchdog = watchdog
-        self._shedding = False
+        self._gate = AdmissionGate(self.max_queue)
+        # per-bucket step wall times (engine clock) — the measured
+        # service model behind service_model()/fleet_sim calibration
+        self._step_wall_s: Dict[int, List[float]] = {}
         self._ttft_s: List[float] = []
         self._latency_s: List[float] = []
         # TTFT/latency decomposition (engine clock; queue + prefill
@@ -351,11 +331,7 @@ class LLMEngine:
         Raises :class:`AdmissionRejected` (retriable) when the bounded
         queue is shedding."""
         depth = self.scheduler.num_waiting
-        if self._shedding and depth <= self.max_queue // 2:
-            self._shedding = False
-        if not self._shedding and depth >= self.max_queue:
-            self._shedding = True
-        if self._shedding:
+        if self._gate.check(depth):
             _STATS["shed"] += 1
             if _metrics.enabled():
                 _metrics.counter(
@@ -614,6 +590,7 @@ class LLMEngine:
         tokens, tbl, lens, qlens = self._batch_arrays(
             plan.seqs, R, Tc, self.max_blocks, self.kv, drafts)
 
+        t_fwd = self._clock()
         try:
             with _trace.span("serve/step", step=self._steps,
                              batch=len(plan.seqs), bucket=Tc):
@@ -633,6 +610,7 @@ class LLMEngine:
             self._draft.forward(tokens, tbl, lens, qlens)
 
         now = self._clock()
+        self._step_wall_s.setdefault(Tc, []).append(now - t_fwd)
         out: Dict[int, object] = {}
         prefill = decode = 0
         spec_proposed = spec_accepted = 0
@@ -975,6 +953,20 @@ class LLMEngine:
             "samples": len(self._queue_s),
         }
         return rep
+
+    def service_model(self):
+        """Measured per-replica service model for fleet planning
+        (:class:`~paddle_tpu.serving.autoscale.ServiceModel`): median
+        step wall time per compiled bucket — warmup/compile steps are
+        excluded by the median — plus this engine's capacity knobs.
+        The same record ``tools/fleet_sim.py`` calibrates from trace
+        sidecars; here it comes straight off the live engine clock."""
+        from .autoscale import ServiceModel
+        return ServiceModel.from_step_samples(
+            self._step_wall_s, max_running=self.max_running,
+            chunk=self.chunk, page_size=self.page_size,
+            num_pages=self.num_pages, max_model_len=self.max_model_len,
+            max_queue=self.max_queue)
 
     def request_timeline(self, rid: int) -> List[dict]:
         """Every flight-recorder event for one request (requires

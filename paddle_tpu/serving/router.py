@@ -50,7 +50,7 @@ from ..profiler import metrics as _metrics, trace as _trace
 from ..runtime.health import HeartbeatTracker
 from ..runtime.watchdog import record_incident, run_with_deadline
 from ..testing.chaos import chaos_point
-from . import engine as _engine
+from . import stats as _stats
 from .errors import (AdmissionRejected, DeadlineExceeded,
                      ReplicaUnavailable)
 from .scheduler import RequestState
@@ -65,7 +65,7 @@ _GIDS = itertools.count()
 _PREFIX_LRU = 64
 
 # per-replica placement/failure tallies for the Profiler "Serving"
-# section (the process-wide _STATS in engine.py stay the aggregate)
+# section (the process-wide STATS in stats.py stay the aggregate)
 _REPLICA_STATS: Dict[str, Dict[str, int]] = {}
 _REPLICA_KEYS = ("placed", "shed", "failovers", "drains", "dead")
 
@@ -124,6 +124,8 @@ class RouterRequest:
     error: Optional[BaseException] = None
     deadline_abs: Optional[float] = None  # router clock
     migrations: int = 0
+    arrival_s: Optional[float] = None     # router clock, at submit()
+    first_token_s: Optional[float] = None  # fleet TTFT observation
 
     @property
     def done(self) -> bool:
@@ -140,14 +142,30 @@ class Router:
     via ``run_with_deadline`` (a blown budget kills the replica);
     ``locality_prefix`` is the prompt-prefix length used for
     cache-locality placement.
+
+    ``autoscaler`` attaches an
+    :class:`~paddle_tpu.serving.autoscale.AutoscalePolicy`: the router
+    feeds it every admission attempt and first-token latency, asks it
+    for a verdict once per step, and surfaces the result
+    (``last_recommendation``, ``serve_fleet_*`` metrics, a
+    ``route/autoscale`` trace event on every non-hold).  Recommend-only
+    by default; ``autoscale_apply=True`` additionally *applies* the
+    one action that needs no new hardware — scale-down drains the
+    least-loaded live replica (idempotent: drain() no-ops on anything
+    already draining).  Scale-up stays a recommendation: provisioning
+    a replica is the operator's move (or ``add_replica()``).
     """
 
     def __init__(self, engines, *, names: Optional[List[str]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  heartbeat_timeout: float = 10.0,
                  step_timeout_s: Optional[float] = None,
-                 locality_prefix: int = 8):
+                 locality_prefix: int = 8,
+                 autoscaler=None, autoscale_apply: bool = False):
         self._clock = clock
+        self.autoscaler = autoscaler
+        self.autoscale_apply = bool(autoscale_apply)
+        self.last_recommendation = None
         self.step_timeout_s = step_timeout_s
         self.locality_prefix = int(locality_prefix)
         self._replicas: "OrderedDict[str, EngineReplica]" = OrderedDict()
@@ -269,6 +287,13 @@ class Router:
 
     def _stream_cb(self, rr: RouterRequest) -> Callable:
         def cb(rid, token, finished):
+            if rr.first_token_s is None:
+                rr.first_token_s = self._clock()
+                if (self.autoscaler is not None
+                        and rr.arrival_s is not None):
+                    self.autoscaler.observe_ttft(
+                        rr.first_token_s - rr.arrival_s,
+                        t=rr.first_token_s)
             rr.tokens.append(int(token))
             if finished:
                 rr.finished = True
@@ -283,20 +308,39 @@ class Router:
         """Admit one stream; returns its gid.  Raises
         :class:`AdmissionRejected` when every live replica sheds and
         :class:`ReplicaUnavailable` when none is live."""
+        now = self._clock()
+        if self.autoscaler is not None:
+            # offered load: shed and unplaceable submissions still
+            # count — the forecast must see the demand we turn away
+            self.autoscaler.observe_arrival(t=now)
         if not self.live_replicas():
             raise ReplicaUnavailable("no live replica to place on")
         rr = RouterRequest(
             gid=next(_GIDS), prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens),
             eos_token_id=eos_token_id, on_token=on_token,
+            arrival_s=now,
             deadline_abs=(None if deadline_s is None
-                          else self._clock() + float(deadline_s)))
+                          else now + float(deadline_s)))
         if not self._place(rr):
             raise AdmissionRejected(
                 f"all {len(self.live_replicas())} live replicas are "
                 f"shedding — retry with backoff")
         self._requests[rr.gid] = rr
         return rr.gid
+
+    def add_replica(self, name: str, engine) -> None:
+        """Attach one new live replica (the scale-up provisioning
+        hook): placement sees it from the next submit/step."""
+        if name in self._replicas:
+            raise ValueError(f"replica {name!r} already attached")
+        self._replicas[name] = EngineReplica(name=name, engine=engine)
+        _trace.event("route/replica_added", kind="router",
+                     replica=name)
+        if _metrics.enabled():
+            _metrics.counter("serve_replicas_added_total",
+                             "Replicas attached after construction",
+                             replica=name).inc()
 
     # -- liveness / failure handling -------------------------------------
     def observe_beat(self, name: str) -> None:
@@ -331,7 +375,7 @@ class Router:
             return
         rep.state = ReplicaState.DEAD
         self._tracker.forget(name)
-        _engine._STATS["replicas_dead"] += 1
+        _stats.STATS["replicas_dead"] += 1
         _replica_stat(name, "dead")
         _trace.event("route/replica_dead", kind="router", replica=name,
                      reason=reason[:200])
@@ -356,7 +400,7 @@ class Router:
         self._placed.pop((rr.replica, rr.rid), None)
         rr.replica = rr.rid = None
         rr.migrations += 1
-        _engine._STATS["failovers"] += 1
+        _stats.STATS["failovers"] += 1
         if src is not None:
             _replica_stat(src, "failovers")
         _trace.event("route/failover", kind="router", gid=rr.gid,
@@ -380,7 +424,7 @@ class Router:
         if rep.state is not ReplicaState.LIVE:
             return 0
         rep.state = ReplicaState.DRAINING
-        _engine._STATS["drains"] += 1
+        _stats.STATS["drains"] += 1
         _replica_stat(name, "drains")
         _trace.event("route/drain", kind="router", replica=name)
         record_incident("serve_replica_drain", replica=name)
@@ -462,7 +506,51 @@ class Router:
             if not self._place(rr):
                 self._orphans.append(rr)
                 break  # nobody can take them this step
+        if self.autoscaler is not None:
+            self._autoscale_step()
         return finished_gids
+
+    def _autoscale_step(self) -> None:
+        """Ask the policy for a verdict and surface it; with
+        ``autoscale_apply``, act on scale-down by draining the
+        least-loaded live replica (one per step — drains migrate
+        work, so pace them)."""
+        live = self.live_replicas()
+        rec = self.autoscaler.recommend(len(live), t=self._clock())
+        self.last_recommendation = rec
+        if _metrics.enabled():
+            _metrics.gauge("serve_fleet_live_replicas",
+                           "Live replicas behind the router").set(
+                len(live))
+            _metrics.gauge("serve_fleet_target_replicas",
+                           "Autoscaler-recommended fleet size").set(
+                rec.target_replicas)
+            _metrics.gauge("serve_fleet_forecast_rps",
+                           "EWMA-forecast offered load").set(
+                rec.forecast_rps)
+            for w, b in rec.burn.items():
+                if b is not None:
+                    _metrics.gauge(
+                        "serve_fleet_burn_rate",
+                        "SLO error-budget burn rate",
+                        window=f"{w:g}s").set(b)
+        if rec.action == "hold":
+            return
+        _trace.event("route/autoscale", kind="router",
+                     action=rec.action, target=rec.target_replicas,
+                     live=len(live), reason=rec.reason[:200])
+        if _metrics.enabled():
+            _metrics.counter("serve_fleet_scale_events_total",
+                             "Non-hold autoscaler recommendations",
+                             action=rec.action).inc()
+        if (rec.action == "scale_down" and self.autoscale_apply
+                and len(live) > max(rec.target_replicas, 1)):
+            def _load(name: str) -> int:
+                sch = self._replicas[name].engine.scheduler
+                return sch.num_waiting + sch.num_running
+            victim = min(live, key=_load)
+            self.drain(victim)
+            self.autoscaler.mark_applied(rec)
 
     def run(self, max_steps: Optional[int] = None) -> Dict[int, List[int]]:
         """Step until every submitted stream is terminal (or

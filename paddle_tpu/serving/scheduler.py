@@ -34,8 +34,8 @@ from typing import Callable, Deque, Dict, List, Optional
 from ..profiler import trace as _trace
 from .kv_cache import PagedKVCache, _cdiv
 
-__all__ = ["Request", "RequestState", "Scheduler", "StepPlan",
-           "ScheduledSeq"]
+__all__ = ["AdmissionGate", "Request", "RequestState", "Scheduler",
+           "StepPlan", "ScheduledSeq"]
 
 _IDS = itertools.count()
 
@@ -107,6 +107,32 @@ class StepPlan:
     # prompt tokens served from the prefix cache by this step's
     # admissions (the engine folds these into serve_prefix_* metrics)
     prefix_hit_tokens: int = 0
+
+
+class AdmissionGate:
+    """Watermark-hysteresis shed gate for the bounded admission queue:
+    start shedding at ``max_queue`` waiting requests, keep shedding
+    until the queue drains below half.  Factored out of the engine so
+    the fleet simulator's replica model sheds *by the same code* —
+    admitted/shed counts match a live run exactly, not approximately.
+    """
+
+    def __init__(self, max_queue: int):
+        self.max_queue = int(max_queue)
+        self.shedding = False
+
+    def check(self, depth: int) -> bool:
+        """Advance the hysteresis for one admission attempt at queue
+        ``depth``; True means shed it."""
+        if self.shedding and depth <= self.max_queue // 2:
+            self.shedding = False
+        if not self.shedding and depth >= self.max_queue:
+            self.shedding = True
+        return self.shedding
+
+    @property
+    def recover_below(self) -> int:
+        return self.max_queue // 2
 
 
 class Scheduler:

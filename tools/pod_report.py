@@ -82,6 +82,21 @@ def _parse_args(argv=None):
                          "the serving section reports router-level "
                          "aggregate capacity (N x plan_capacity) "
                          "alongside the per-engine numbers")
+    ap.add_argument("--fleet-workload", default="diurnal",
+                    help="seeded arrival preset (serving.workloads) "
+                         "the serving section's fleet block sizes "
+                         "against; 'none' disables the block")
+    ap.add_argument("--fleet-requests", type=int, default=200)
+    ap.add_argument("--fleet-seed", type=int, default=0)
+    ap.add_argument("--fleet-horizon-s", type=float, default=60.0)
+    ap.add_argument("--fleet-prompt-len", type=int, default=12)
+    ap.add_argument("--fleet-new-tokens", type=int, default=8)
+    ap.add_argument("--max-running", type=int, default=8,
+                    help="per-replica engine slots assumed by the "
+                         "fleet block's service model")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="prefill chunk assumed by the fleet block's "
+                         "service model")
     ap.add_argument("--prefix-hit-rate", type=float, default=None,
                     help="measured shared-prefix hit rate in [0, 1) "
                          "(e.g. the prefix_hit_rate from bench_serve "
@@ -441,7 +456,46 @@ def _serving_section(cfg, gen, args):
         "num_pages": n * plan["num_pages"],
         "usable_kv_bytes": n * plan["usable_kv_bytes"],
     }
+    fleet = _fleet_block(plan, args)
+    if fleet is not None:
+        plan["fleet"] = fleet
     return plan
+
+
+def _fleet_block(plan, args):
+    """Analytic fleet sizing for this plan's page pool: the shared
+    ``serving.autoscale.recommend_fleet`` arithmetic over the same
+    seeded arrival stream ``tools/fleet_sim.py`` simulates — by
+    construction the two tools return the same min-replica answer for
+    the same knobs (the consistency test pins it)."""
+    preset = getattr(args, "fleet_workload", None)
+    if not preset or preset == "none":
+        return None
+    try:
+        from paddle_tpu.serving import autoscale, workloads
+    except ImportError:
+        return None
+    workloads.validate(preset)
+    arrivals = workloads.generate(
+        preset, int(args.fleet_requests), seed=int(args.fleet_seed),
+        horizon_s=float(args.fleet_horizon_s),
+        prompt_len=int(args.fleet_prompt_len),
+        max_new_tokens=int(args.fleet_new_tokens))
+    model = autoscale.ServiceModel(
+        max_running=int(args.max_running), chunk=int(args.chunk),
+        page_size=int(plan["page_size"]),
+        num_pages=int(plan["num_pages"]),
+        max_model_len=int(plan["max_model_len"]),
+        max_queue=8 * int(args.max_running))
+    rec = autoscale.recommend_fleet(model, arrivals)
+    rec["workload"] = preset
+    rec["seed"] = int(args.fleet_seed)
+    rec["horizon_s"] = float(args.fleet_horizon_s)
+    rec["service_model"] = model.to_dict()
+    rec["note"] = ("uncalibrated step costs (shared defaults); feed a "
+                   "measured trace or bench_serve fleet block through "
+                   "tools/fleet_sim.py to validate under simulation")
+    return rec
 
 
 def build_serving_report(args):
