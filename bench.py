@@ -19,12 +19,31 @@ import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _LAST_FILE = os.path.join(_REPO, ".bench_last.json")
+_LEDGER_OUT = os.environ.get("PADDLE_TPU_BENCH_LEDGER_OUT")
 _T0 = time.monotonic()
 
 
 def _log(msg):
     sys.stderr.write(f"bench[{time.monotonic() - _T0:6.1f}s]: {msg}\n")
     sys.stderr.flush()
+
+
+def _ledger_append(result):
+    """Append the normalized row to the perf ledger (--ledger-out).
+
+    Runs on success AND error paths — an error round is a ledger row too
+    — but a ledger failure must never break the bench JSON line."""
+    if not _LEDGER_OUT:
+        return
+    try:
+        from paddle_tpu.profiler import ledger as _ledger
+        cmd = "python " + " ".join(
+            [os.path.basename(sys.argv[0] or "bench.py")] + sys.argv[1:])
+        row = _ledger.from_bench_result(result, ts=time.time(), cmd=cmd)
+        _ledger.append(_LEDGER_OUT, row)
+        _log(f"ledger row appended to {_LEDGER_OUT}")
+    except Exception as e:
+        _log(f"ledger append failed: {e}")
 
 
 def _enable_compile_cache():
@@ -460,14 +479,17 @@ def run_multichip(n_devices=8, trace_out=None):
             lambda: multichip_main(n_devices, trace_out=trace_out),
             timeout_s, phase="measure")
     except PhaseTimeout:
-        print(json.dumps(_error_result(
-            f"multichip bench timed out after {timeout_s:.0f}s")))
+        result = _error_result(
+            f"multichip bench timed out after {timeout_s:.0f}s")
+        print(json.dumps(result))
         sys.stdout.flush()
+        _ledger_append(result)
         _persist_incidents_quietly(persist_incidents)
         os._exit(0)
     except BaseException as e:  # noqa: BLE001 — the line must print
         result = _error_result(str(e) or repr(e))
     print(json.dumps(result))
+    _ledger_append(result)
     return 0
 
 
@@ -556,26 +578,31 @@ def run():
         _probe, window_s=dev_timeout_s, base_delay=retry_delay_s,
         log=_log)
     if not ok:
-        print(json.dumps(_error_result(
+        result = _error_result(
             f"device backend init failed within {dev_timeout_s:.0f}s "
             f"({attempts} attempt(s); TPU tunnel down or unclaimable): "
-            f"{err}")))
+            f"{err}")
+        print(json.dumps(result))
         sys.stdout.flush()
+        _ledger_append(result)
         _persist_incidents_quietly(persist_incidents)
         os._exit(0)  # a hung init thread would block a clean exit
 
     try:
         result = run_with_deadline(main, timeout_s, phase="measure")
     except PhaseTimeout:
-        print(json.dumps(_error_result(
+        result = _error_result(
             f"bench timed out after {timeout_s:.0f}s "
-            "(compile or execute hang)")))
+            "(compile or execute hang)")
+        print(json.dumps(result))
         sys.stdout.flush()
+        _ledger_append(result)
         _persist_incidents_quietly(persist_incidents)
         os._exit(0)  # the hung measure thread would block a clean exit
     except BaseException as e:  # noqa: BLE001 — the line must print
         result = _error_result(str(e) or repr(e))
     print(json.dumps(result))
+    _ledger_append(result)
     return 0
 
 
@@ -593,6 +620,14 @@ if __name__ == "__main__":
                          "rank-tagged trace sidecar into DIR "
                          "(--multichip only; read it with "
                          "tools/trace_report.py)")
+    ap.add_argument("--ledger-out", nargs="?", metavar="PATH",
+                    const=os.path.join(_REPO, "PERF_LEDGER.jsonl"),
+                    default=_LEDGER_OUT,
+                    help="append the normalized run record (with "
+                         "provenance) to the perf ledger at PATH "
+                         "(default PERF_LEDGER.jsonl; gate it with "
+                         "tools/perf_ledger.py check)")
     cli = ap.parse_args()
+    _LEDGER_OUT = cli.ledger_out
     sys.exit(run_multichip(cli.devices, trace_out=cli.trace_out)
              if cli.multichip else run())

@@ -58,6 +58,13 @@ JSONL sidecar under DIR, the SLO block gains the TTFT breakdown
 (queue/prefill/decode p95), and the sidecar path rides in the JSON
 line — feed it to ``tools/trace_report.py`` for per-request timelines
 whose breakdown sums exactly to the measured TTFT.
+
+``--ledger-out [PATH]`` (or PADDLE_TPU_BENCH_LEDGER_OUT) appends the
+normalized provenance-stamped row to the perf ledger (default
+``PERF_LEDGER.jsonl``; gate it with ``tools/perf_ledger.py check``).
+With ``FLAGS_tpu_metrics_port`` set the run is scrapeable live at
+``/metrics`` and ``/slo`` (``paddle_tpu/profiler/exporter.py``) and the
+JSON line carries the bound ``metrics_port``.
 """
 from __future__ import annotations
 
@@ -67,8 +74,43 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
-_LAST_FILE = os.path.join(_REPO, ".bench_serve_last.json")
+# scratch record of the last successful run lives under runs/ (untracked)
+# — the durable artifact is the perf ledger row (--ledger-out)
+_LAST_FILE = os.path.join(_REPO, "runs", "bench_serve_last.json")
+_LAST_FILE_LEGACY = os.path.join(_REPO, ".bench_serve_last.json")
 _T0 = time.monotonic()
+
+
+def _ledger_out():
+    """--ledger-out [PATH] / PADDLE_TPU_BENCH_LEDGER_OUT: perf ledger
+    destination, or None when ledger emission is off."""
+    path = os.environ.get("PADDLE_TPU_BENCH_LEDGER_OUT")
+    if "--ledger-out" in sys.argv:
+        i = sys.argv.index("--ledger-out")
+        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("--"):
+            path = sys.argv[i + 1]
+        else:
+            path = os.path.join(_REPO, "PERF_LEDGER.jsonl")
+    return path
+
+
+def _ledger_append(result):
+    """Append the normalized row (success or error) to the perf ledger;
+    a ledger failure must never break the BENCH_SERVE line."""
+    path = _ledger_out()
+    if not path:
+        return
+    try:
+        from paddle_tpu.profiler import ledger as _ledger
+        cmd = "python " + " ".join(
+            [os.path.basename(sys.argv[0] or "bench_serve.py")]
+            + sys.argv[1:])
+        row = _ledger.from_bench_serve_result(result, ts=time.time(),
+                                              cmd=cmd)
+        _ledger.append(path, row)
+        _log(f"ledger row appended to {path}")
+    except Exception as e:
+        _log(f"ledger append failed: {e}")
 
 
 def _log(msg):
@@ -418,12 +460,26 @@ def main():
     }
     if trace_sidecar is not None:
         result["trace_sidecar"] = trace_sidecar
+    exp = _exporter_active()
+    if exp is not None:
+        result["metrics_port"] = exp.port
     try:
+        os.makedirs(os.path.dirname(_LAST_FILE), exist_ok=True)
         with open(_LAST_FILE, "w") as f:
             json.dump(result, f)
     except OSError:
         pass
     return result
+
+
+def _exporter_active():
+    """The live exporter, if FLAGS_tpu_metrics_port started one when the
+    engine was constructed."""
+    try:
+        from paddle_tpu.profiler import exporter
+        return exporter.active()
+    except Exception:
+        return None
 
 
 def _error_result(msg, incident=None):
@@ -441,11 +497,13 @@ def _error_result(msg, incident=None):
             incident = None
     if incident is not None:
         out["incident"] = incident
-    try:
-        with open(_LAST_FILE) as f:
-            out["last_measured"] = json.load(f)
-    except Exception:
-        pass
+    for path in (_LAST_FILE, _LAST_FILE_LEGACY):
+        try:
+            with open(path) as f:
+                out["last_measured"] = json.load(f)
+            break
+        except Exception:
+            continue
     return out
 
 
@@ -461,10 +519,12 @@ def run():
     try:
         result = run_with_deadline(main, timeout_s, phase="serve_measure")
     except PhaseTimeout:
-        print("BENCH_SERVE " + json.dumps(_error_result(
+        result = _error_result(
             f"bench_serve timed out after {timeout_s:.0f}s "
-            "(compile or execute hang)")))
+            "(compile or execute hang)")
+        print("BENCH_SERVE " + json.dumps(result))
         sys.stdout.flush()
+        _ledger_append(result)
         try:
             # os._exit skips atexit — flush the incident sidecar now
             persist_incidents()
@@ -474,6 +534,7 @@ def run():
     except BaseException as e:  # noqa: BLE001 — the line must print
         result = _error_result(str(e) or repr(e))
     print("BENCH_SERVE " + json.dumps(result))
+    _ledger_append(result)
     return 0
 
 

@@ -61,6 +61,12 @@ define_flag("FLAGS_tpu_metrics", False,
             "Enable the profiler.metrics registry (counters/gauges/"
             "histograms on optimizer, collectives, dataloader, predictor). "
             "Off: every recording call is a dict lookup + bool check.")
+define_flag("FLAGS_tpu_metrics_port", 0,
+            "Serve live observability over HTTP (profiler.exporter): "
+            "/metrics (Prometheus text), /healthz, /slo, /incidents, "
+            "/trace/tail. 0 disables (the check is one dict lookup); "
+            "-1 binds an ephemeral port; >0 binds that port, falling "
+            "back to an ephemeral one if it is taken.")
 define_flag("FLAGS_tpu_check_nan_inf", False,
             "Framework-wide numerics watchdog: check_numerics sites and "
             "to_static output checks scan for NaN/Inf, with first-bad-op "

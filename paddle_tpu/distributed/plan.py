@@ -35,6 +35,7 @@ import json
 import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..profiler import exporter as _exporter
 from ..profiler import trace as _trace
 
 __all__ = ["Plan", "PlanError", "PlanCompilationError",
@@ -476,6 +477,11 @@ class Plan:
 
         history = {"losses": [], "world_sizes": [], "resizes": []}
         step_idx = 0
+        # live observability: /healthz reports train progress when
+        # FLAGS_tpu_metrics_port is set (no-op otherwise)
+        _train_status = {"job_id": job_id, "step": 0, "loss": None,
+                         "world_size": plan.world_size, "done": False}
+        _exporter.maybe_serve("train", lambda: dict(_train_status))
         for batch in batches:
             want = _poll_scale()
             if (want is not None and want != plan.world_size
@@ -509,4 +515,8 @@ class Plan:
             history["losses"].append(float(metrics["loss"]))
             history["world_sizes"].append(plan.world_size)
             step_idx += 1
+            _train_status.update(step=step_idx,
+                                 loss=history["losses"][-1],
+                                 world_size=plan.world_size)
+        _train_status["done"] = True
         return history

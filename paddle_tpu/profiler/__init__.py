@@ -16,6 +16,12 @@ Telemetry siblings in this package:
                         (FLAGS_tpu_check_nan_inf)
   trace.py            — structured event/span flight recorder with
                         JSONL sidecars (FLAGS_tpu_trace)
+  exporter.py         — live HTTP observability endpoint: /metrics,
+                        /healthz, /slo, /incidents, /trace/tail
+                        (FLAGS_tpu_metrics_port)
+  ledger.py           — provenance-stamped perf ledger: schema, direction-
+                        aware metric registry, regression/staleness gate
+                        (stdlib-only; CLI at tools/perf_ledger.py)
 """
 from __future__ import annotations
 
@@ -34,10 +40,13 @@ from . import compile_tracker
 from . import xmem
 from . import numerics
 from . import trace
+from . import exporter
+from . import ledger
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
            "RecordEvent", "export_chrome_tracing", "benchmark", "metrics",
-           "compile_tracker", "xmem", "numerics", "trace"]
+           "compile_tracker", "xmem", "numerics", "trace", "exporter",
+           "ledger"]
 
 # host-span aggregation for the summary stats table (reference:
 # profiler/profiler_statistic.py — EventSummary/statistic_data tables).

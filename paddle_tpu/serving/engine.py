@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..profiler import exporter as _exporter
 from ..profiler import metrics as _metrics
 from ..profiler import numerics as _numerics
 from ..profiler import trace as _trace
@@ -316,6 +317,8 @@ class LLMEngine:
 
         _STATS["engines"] += 1
         _STATS["pool_bytes"] += pool_bytes
+
+        _exporter.maybe_serve("engine", self)
 
     # -- request intake --------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int,

@@ -46,6 +46,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from ..profiler import exporter as _exporter
 from ..profiler import metrics as _metrics, trace as _trace
 from ..runtime.health import HeartbeatTracker
 from ..runtime.watchdog import record_incident, run_with_deadline
@@ -186,6 +187,8 @@ class Router:
         # submitted but currently unplaceable (mid-failover overload)
         self._orphans: Deque[RouterRequest] = deque()
         self._steps = 0
+
+        _exporter.maybe_serve("router", self)
 
     # -- introspection ---------------------------------------------------
     def replica_states(self) -> Dict[str, str]:

@@ -1,0 +1,324 @@
+"""Live observability exporter (ISSUE 17): /metrics, /healthz, /slo,
+/incidents, /trace/tail over FLAGS_tpu_metrics_port.
+
+The acceptance bar: the disabled path is one dict lookup (maybe_serve
+returns None without touching sockets); with the flag set an LLMEngine
+run is scrapeable mid-flight and the final /slo scrape agrees with the
+engine's own ``slo_report()``; a taken port falls back to an ephemeral
+bind instead of crashing the replica; and a live ``bench_serve.py``
+subprocess is scrapeable at /metrics and /slo mid-run with scraped
+serve_* values agreeing with the final BENCH_SERVE JSON line within
+tolerance.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.models import llama
+from paddle_tpu.ops import pallas_ops
+from paddle_tpu.profiler import exporter, metrics
+from paddle_tpu.serving.autoscale import AutoscalePolicy, ServiceModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = pallas_ops._INTERPRET
+    pallas_ops._INTERPRET = True
+    yield
+    pallas_ops._INTERPRET = old
+
+
+@pytest.fixture(autouse=True)
+def _exporter_off():
+    """Every test starts and ends with the exporter down, flag off."""
+    old = _flags._REGISTRY["FLAGS_tpu_metrics_port"]
+    exporter.shutdown()
+    yield
+    _flags.set_flags({"FLAGS_tpu_metrics_port": old})
+    exporter.shutdown()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _get_json(port, path):
+    status, body = _get(port, path)
+    assert status == 200, body
+    return json.loads(body)
+
+
+def _tiny_cfg():
+    return llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, dtype=jax.numpy.float32,
+        use_remat=False)
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_path_is_inert():
+    _flags.set_flags({"FLAGS_tpu_metrics_port": 0})
+    assert exporter.maybe_serve("engine", object()) is None
+    assert exporter.active() is None
+
+
+def test_engine_constructor_does_not_start_exporter_when_off():
+    _flags.set_flags({"FLAGS_tpu_metrics_port": 0})
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    serving.LLMEngine(cfg, params, max_running=2, chunk=4, page_size=8,
+                      max_model_len=32)
+    assert exporter.active() is None
+
+
+def test_flag_minus_one_binds_ephemeral_port():
+    _flags.set_flags({"FLAGS_tpu_metrics_port": -1})
+    exp = exporter.maybe_serve()
+    assert exp is not None and exp.port > 0
+    status, body = _get(exp.port, "/healthz")
+    assert status == 200 and json.loads(body)["ok"]
+
+
+def test_port_conflict_falls_back_to_ephemeral():
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    taken = blocker.getsockname()[1]
+    try:
+        _flags.set_flags({"FLAGS_tpu_metrics_port": taken})
+        exp = exporter.maybe_serve()
+        assert exp is not None
+        assert exp.port != taken and exp.port > 0
+        assert _get(exp.port, "/healthz")[0] == 200
+    finally:
+        blocker.close()
+
+
+def test_portfile_records_bound_port(tmp_path, monkeypatch):
+    portfile = tmp_path / "port"
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORTFILE", str(portfile))
+    _flags.set_flags({"FLAGS_tpu_metrics_port": -1})
+    exp = exporter.maybe_serve()
+    assert int(portfile.read_text()) == exp.port
+
+
+# ---------------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_endpoint_serves_prometheus_text():
+    _flags.set_flags({"FLAGS_tpu_metrics_port": -1,
+                      "FLAGS_tpu_metrics": True})
+    try:
+        metrics.counter("exporter_test_total", "counter under test").inc(3)
+        exp = exporter.maybe_serve()
+        status, body = _get(exp.port, "/metrics")
+        assert status == 200
+        assert "exporter_test_total 3" in body
+    finally:
+        _flags.set_flags({"FLAGS_tpu_metrics": False})
+        metrics.reset()
+
+
+def test_incidents_and_trace_tail_endpoints():
+    from paddle_tpu.runtime import watchdog
+    _flags.set_flags({"FLAGS_tpu_metrics_port": -1})
+    exp = exporter.maybe_serve()
+    watchdog.record_incident("exporter_test", detail="synthetic")
+    doc = _get_json(exp.port, "/incidents?n=5")
+    assert doc["count"] >= 1
+    assert doc["tail"][-1]["kind"] == "exporter_test"
+    doc = _get_json(exp.port, "/trace/tail?n=5")
+    assert doc["enabled"] is False and doc["tail"] == []
+    assert _get(exp.port, "/nope")[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# live engine scrape
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_scrape_during_engine_run_matches_final_report():
+    """Scrapes from a background thread while the engine steps must
+    never error, and the post-run /slo scrape equals the engine's own
+    slo_report()."""
+    _flags.set_flags({"FLAGS_tpu_metrics_port": -1})
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = serving.LLMEngine(cfg, params, max_running=4, chunk=4,
+                            page_size=8, max_model_len=32,
+                            slo=serving.SLOConfig(ttft_p95_s=10.0,
+                                                  latency_p95_s=10.0))
+    exp = exporter.active()
+    assert exp is not None, "engine constructor must start the exporter"
+
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        eng.add_request(list(rng.randint(0, 128, 5 + i)), 4)
+
+    scraped, errors = [], []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                scraped.append(_get_json(exp.port, "/slo"))
+                _get(exp.port, "/metrics")
+                _get(exp.port, "/healthz")
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+            time.sleep(0.002)
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+        assert steps < 500
+    stop.set()
+    t.join(timeout=10)
+    assert not errors, errors
+    assert scraped, "scraper never completed a request"
+
+    final = _get_json(exp.port, "/slo")
+    (eng_view,) = final["engines"]
+    own = eng.slo_report()
+    assert eng_view["ttft_p95_s"] == pytest.approx(
+        float(own["ttft_p95_s"]), rel=1e-6)
+    assert eng_view["latency_p95_s"] == pytest.approx(
+        float(own["latency_p95_s"]), rel=1e-6)
+    health = _get_json(exp.port, "/healthz")
+    assert health["engines"][0]["num_running"] == 0
+
+
+def test_router_attachment_exposes_burn_rates_and_recommendation():
+    _flags.set_flags({"FLAGS_tpu_metrics_port": -1})
+    cfg = _tiny_cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = serving.LLMEngine(cfg, params, max_running=2, chunk=4,
+                            page_size=8, max_model_len=32)
+    clock_t = [0.0]
+    model = ServiceModel(max_running=2, chunk=4, page_size=8, num_pages=9,
+                         max_model_len=32, max_queue=32)
+    policy = AutoscalePolicy(model, slo_ttft_s=0.5,
+                             clock=lambda: clock_t[0])
+    router = serving.Router([("r0", eng)], autoscaler=policy,
+                            clock=lambda: clock_t[0])
+    exp = exporter.active()
+    doc = _get_json(exp.port, "/slo")
+    assert doc["router"]["live_replicas"] == ["r0"]
+    assert doc["burn_rates"] is not None
+    health = _get_json(exp.port, "/healthz")
+    assert health["router"]["replicas"] == {"r0": "live"}
+
+
+# ---------------------------------------------------------------------------
+# live bench_serve subprocess scrape (slow: full bench in a subprocess)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_serve_scrapeable_mid_run(tmp_path):
+    """End-to-end acceptance: with FLAGS_tpu_metrics_port set a live
+    bench_serve.py run is scrapeable at /metrics and /slo mid-run, the
+    scraped serve_* values agree with the final JSON within tolerance,
+    and the line carries the bound metrics_port."""
+    portfile = tmp_path / "port"
+    ledger = tmp_path / "ledger.jsonl"
+    env = dict(os.environ)
+    env.update({
+        "FLAGS_tpu_metrics_port": "-1",
+        "PADDLE_TPU_METRICS_PORTFILE": str(portfile),
+        "PADDLE_TPU_BENCH_LEDGER_OUT": str(ledger),
+        "PADDLE_TPU_BENCH_SERVE_REQUESTS": "24",
+        "PADDLE_TPU_BENCH_SERVE_PROMPT": "8",
+        "PADDLE_TPU_BENCH_SERVE_NEW": "4",
+        "PADDLE_TPU_BENCH_SERVE_MAX_RUNNING": "4",
+        "PADDLE_TPU_BENCH_SERVE_CHUNK": "4",
+        "PADDLE_TPU_BENCH_TIMEOUT": "300",
+    })
+    proc = subprocess.Popen([sys.executable, "bench_serve.py"],
+                            cwd=REPO, env=env, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 300
+        port = None
+        while time.monotonic() < deadline:
+            if portfile.exists() and portfile.read_text().strip():
+                port = int(portfile.read_text())
+                break
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                pytest.fail(f"bench_serve exited before serving:\n{err}")
+            time.sleep(0.1)
+        assert port, "exporter portfile never appeared"
+
+        # mid-run scrapes: poll until the engine registers, then sample
+        mid_slo = None
+        mid_metrics = False
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                doc = _get_json(port, "/slo")
+                status, _ = _get(port, "/metrics")
+                mid_metrics = mid_metrics or status == 200
+                if doc["engines"]:
+                    mid_slo = doc
+            except Exception:
+                # the endpoint dies with the (short) bench process; a
+                # scrape racing that exit is not a failure
+                time.sleep(0.01)
+            time.sleep(0.005)
+        out, err = proc.communicate(timeout=300)
+        assert mid_slo is not None, \
+            f"never scraped a live engine mid-run:\n{err}"
+        assert mid_metrics, "never scraped /metrics mid-run"
+
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("BENCH_SERVE ")]
+        assert len(lines) == 1, out + err
+        final = json.loads(lines[0].split("BENCH_SERVE ", 1)[1])
+        assert "error" not in final, final
+        assert final["metrics_port"] == port
+        # the mid-run p95 view and the final line measure the same run:
+        # scraped TTFT p95 must agree with the final JSON within
+        # tolerance (mid-run sample may lack the last requests)
+        slo_block = final["resilience"]["slo"]
+        (eng_view,) = mid_slo["engines"]
+        assert eng_view["ttft_p95_s"] * 1000.0 == pytest.approx(
+            slo_block["ttft_p95_ms"], rel=0.5, abs=5.0)
+        # satellite: --ledger-out / env emitted the normalized row
+        rows = [json.loads(ln) for ln in
+                ledger.read_text().splitlines() if ln.strip()]
+        assert len(rows) == 1
+        assert rows[0]["metrics"]["serve_tokens_per_sec_chip"] == \
+            pytest.approx(final["value"])
+        assert rows[0]["provenance"]["real_device"] is False
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()

@@ -1,0 +1,353 @@
+"""Perf ledger (ISSUE 17): schema round-trip, direction-aware regression
+gate, staleness verdict, artifact ingestion, and the stdlib-only CLI.
+
+The acceptance bar: the committed ``PERF_LEDGER.jsonl`` passes ``check``
+and its ``report`` reproduces the known trajectory (62.41%% MFU at r5,
+multichip 144.84 ms/step with vs_baseline 0.789 at r6) with no jax
+import; a seeded tokens/s regression and a stale-measurement ledger both
+exit 1; schema garbage exits 2; the chip-free proxy gate
+(``check --proxies-only``) is a tier-1 ratchet that can never silently
+regress.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "perf_ledger.py")
+COMMITTED = os.path.join(REPO, "PERF_LEDGER.jsonl")
+
+_ARTIFACTS = ([os.path.join(REPO, f"BENCH_r0{i}.json") for i in range(1, 7)]
+              + [os.path.join(REPO, f"MULTICHIP_r0{i}.json")
+                 for i in range(1, 6)]
+              + [os.path.join(REPO, "FLEET_r01.json")])
+
+
+@pytest.fixture(scope="module")
+def L():
+    """ledger.py loaded standalone — the tools/perf_ledger.py path."""
+    spec = importlib.util.spec_from_file_location(
+        "_ledger_under_test",
+        os.path.join(REPO, "paddle_tpu", "profiler", "ledger.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_cli(*args, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    return subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+
+
+def _measured(L, value, *, round, metric="tokens_per_sec_per_chip",
+              source="bench.py", real=True):
+    return L.new_record(source, {metric: value}, kind="measured",
+                        round=round,
+                        provenance={"device": "TPU v5e" if real else "cpu",
+                                    "real_device": real})
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip + validation
+# ---------------------------------------------------------------------------
+
+
+def test_record_roundtrip(L, tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    rec = L.new_record("bench.py", {"mfu_percent": 62.41,
+                                    "tokens_per_sec_per_chip": 20082.8},
+                       round=5, ts=1234.5,
+                       provenance=L.collect_provenance(device="TPU v5e"),
+                       detail={"note": "roundtrip"})
+    L.append(path, rec)
+    (back,) = L.load(path)
+    assert back == json.loads(L.dumps(rec))
+    assert back["schema"] == L.SCHEMA
+    assert back["provenance"]["real_device"] is True
+
+
+def test_unknown_metric_rejected(L):
+    with pytest.raises(L.LedgerSchemaError, match="unknown metric"):
+        L.new_record("bench.py", {"tokens_per_sec": 1.0})
+
+
+def test_measured_metric_cannot_ride_proxy_row(L):
+    with pytest.raises(L.LedgerSchemaError, match="measured-only"):
+        L.new_record("pod_report", {"mfu_percent": 62.0}, kind="proxy")
+
+
+def test_load_rejects_garbage_with_line_number(L, tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"schema": "paddle_tpu.perf_ledger.v1", "round": 1, '
+                    '"source": "bench.py", "kind": "measured", '
+                    '"metrics": {"mfu_percent": 1.0}}\nnot json\n')
+    with pytest.raises(L.LedgerSchemaError, match=":2:"):
+        L.load(str(path))
+
+
+def test_every_metric_declares_direction(L):
+    for name, spec in L.METRICS.items():
+        assert spec.direction in ("higher", "lower"), name
+        assert isinstance(spec.proxy, bool), name
+
+
+# ---------------------------------------------------------------------------
+# direction-aware gate
+# ---------------------------------------------------------------------------
+
+
+def test_gate_fires_on_higher_better_regression(L):
+    recs = [_measured(L, 20000.0, round=1), _measured(L, 17000.0, round=2)]
+    verdict = L.check(recs, tol=0.05)
+    assert not verdict["ok"]
+    (r,) = verdict["regressions"]
+    assert r["metric"] == "tokens_per_sec_per_chip"
+    assert r["latest"] == 17000.0
+
+
+def test_gate_passes_on_improvement_and_in_band_noise(L):
+    # improvement: must NOT fire, this is the whole point of direction
+    assert L.check([_measured(L, 20000.0, round=1),
+                    _measured(L, 25000.0, round=2)], tol=0.05)["ok"]
+    # 3% dip is inside the 5% tolerance band
+    assert L.check([_measured(L, 20000.0, round=1),
+                    _measured(L, 19400.0, round=2)], tol=0.05)["ok"]
+
+
+def test_gate_fires_on_lower_better_regression(L):
+    recs = [_measured(L, 140.0, round=1, metric="multichip_step_ms",
+                      source="bench.py --multichip"),
+            _measured(L, 180.0, round=2, metric="multichip_step_ms",
+                      source="bench.py --multichip")]
+    assert not L.check(recs, tol=0.05)["ok"]
+    # and the mirror-image improvement passes
+    recs = [_measured(L, 180.0, round=1, metric="multichip_step_ms",
+                      source="bench.py --multichip"),
+            _measured(L, 140.0, round=2, metric="multichip_step_ms",
+                      source="bench.py --multichip")]
+    assert L.check(recs, tol=0.05)["ok"]
+
+
+def test_gate_separates_series_by_label(L):
+    # int8 and bf16 serve lines are different series: one regressing
+    # while the other improves must flag exactly the regressing one
+    def serve(value, label, rnd):
+        return L.new_record("bench_serve.py",
+                            {"serve_tokens_per_sec_chip": value},
+                            label=label, round=rnd,
+                            provenance={"real_device": True})
+    recs = [serve(250.0, "kv=bf16", 1), serve(100.0, "kv=int8", 1),
+            serve(260.0, "kv=bf16", 2), serve(80.0, "kv=int8", 2)]
+    verdict = L.check(recs, tol=0.05)
+    assert [r["label"] for r in verdict["regressions"]] == ["kv=int8"]
+
+
+def test_staleness_verdict(L):
+    recs = [_measured(L, 20000.0, round=3),
+            L.new_record("bench.py", {}, kind="error", round=6)]
+    verdict = L.check(recs, stale_after=3)
+    assert not verdict["ok"]
+    assert verdict["stale"]["age_rounds"] == 3
+    assert verdict["stale"]["newest_measured_round"] == 3
+    # a fresh real-device measurement clears it
+    recs.append(_measured(L, 20100.0, round=6))
+    assert L.check(recs, stale_after=3)["ok"]
+
+
+def test_cpu_smoke_does_not_refresh_staleness_clock(L):
+    # the r04/r05 failure mode: CPU rows must not masquerade as fresh
+    # silicon measurements
+    recs = [_measured(L, 20000.0, round=1),
+            _measured(L, 150.0, round=6, metric="multichip_step_ms",
+                      source="bench.py --multichip", real=False)]
+    verdict = L.check(recs, stale_after=3)
+    assert verdict["stale"]["newest_measured_round"] == 1
+
+
+def test_proxies_only_gates_proxies_and_skips_staleness(L):
+    stale_measured = [_measured(L, 20000.0, round=1),
+                      L.new_record("bench.py", {}, kind="error", round=9)]
+    proxies = [L.new_record("pod_report", {"plan_capacity": 32.0},
+                            kind="proxy", round=8),
+               L.new_record("pod_report", {"plan_capacity": 16.0},
+                            kind="proxy", round=9)]
+    # full check: stale; proxies-only: staleness waived but the halved
+    # plan_capacity still fires
+    assert not L.check(stale_measured, stale_after=3)["ok"]
+    assert L.check(stale_measured, stale_after=3,
+                   proxies_only=True)["ok"]
+    verdict = L.check(stale_measured + proxies, proxies_only=True)
+    assert [r["metric"] for r in verdict["regressions"]] == \
+        ["plan_capacity"]
+
+
+# ---------------------------------------------------------------------------
+# normalizers + artifact ingestion
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_reproduces_known_trajectory(L):
+    rows = L.ingest_artifacts(_ARTIFACTS)
+    text = L.report(rows, fmt="json")
+    doc = json.loads(text)
+    by_metric = {(s["metric"], s["source"]): s for s in doc["series"]}
+    mfu = by_metric[("mfu_percent", "bench.py")]
+    assert mfu["trajectory"] == [{"round": 3, "value": 62.27},
+                                 {"round": 5, "value": 62.41}]
+    step = by_metric[("multichip_step_ms", "bench.py --multichip")]
+    assert step["latest"] == 144.84
+    vs = by_metric[("multichip_vs_lockstep", "bench.py --multichip")]
+    assert vs["latest"] == 0.789
+    fleet = by_metric[("fleet_min_replicas", "fleet_sim")]
+    assert fleet["latest"] == 2.0
+    # the six BENCH rounds: r01/r02 parse failures and r03/r04/r05
+    # timeouts are error rows, not silent gaps
+    errors = [r for r in rows if r["kind"] == "error"]
+    assert len(errors) == 5
+    # ingestion is deterministic: byte-identical on re-run
+    again = L.ingest_artifacts(_ARTIFACTS)
+    assert [L.dumps(r) for r in rows] == [L.dumps(r) for r in again]
+
+
+def test_committed_ledger_matches_artifact_ingest(L):
+    committed = L.load(COMMITTED)
+    rows = L.ingest_artifacts(_ARTIFACTS)
+    # driver-artifact rows are the committed prefix (the tail carries
+    # rows appended by later bench runs, e.g. the ingested serve line)
+    assert len(committed) >= len(rows)
+    assert ([L.dumps(r) for r in committed[:len(rows)]]
+            == [L.dumps(r) for r in rows])
+
+
+def test_committed_ledger_passes_gate(L):
+    verdict = L.check(L.load(COMMITTED))
+    assert verdict["ok"], verdict
+
+
+def test_from_bench_serve_result_labels_series(L):
+    with open(os.path.join(REPO, ".bench_serve_last.json")) as f:
+        payload = json.load(f)
+    row = L.from_bench_serve_result(payload, round=None)
+    assert row["label"] == "llama-debug:uniform:kv=bf16"
+    assert row["metrics"]["serve_tokens_per_sec_chip"] == 263.35
+    assert row["metrics"]["serve_ttft_p95_ms"] == 10.0
+    assert row["provenance"]["real_device"] is False
+
+
+def test_from_pod_report_serving_shape(L):
+    report = {"mode": "serving", "preset": "llama7b", "mesh": "v5p-16",
+              "serving": {"max_concurrent_requests": 64,
+                          "capacity_ratio_vs_bf16": 1.0,
+                          "fleet": {"min_replicas": 2}}}
+    row = L.from_pod_report(report, round=7)
+    assert row["kind"] == "proxy"
+    assert row["metrics"] == {"plan_capacity": 64.0,
+                              "kv_capacity_ratio_vs_bf16": 1.0,
+                              "fleet_min_replicas": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit-code matrix, no-jax guard, tier-1 proxy ratchet
+# ---------------------------------------------------------------------------
+
+
+def test_cli_check_ok_on_committed_history():
+    p = _run_cli("check")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_cli_exit_1_on_seeded_regression(L, tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    L.append(path, _measured(L, 20000.0, round=1))
+    L.append(path, _measured(L, 15000.0, round=2))
+    p = _run_cli("--ledger", path, "check")
+    assert p.returncode == 1, p.stdout + p.stderr
+    verdict = json.loads(p.stdout)
+    assert verdict["regressions"]
+
+
+def test_cli_exit_1_on_stale_ledger(L, tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    L.append(path, _measured(L, 20000.0, round=2))
+    L.append(path, L.new_record("bench.py", {}, kind="error", round=9))
+    p = _run_cli("--ledger", path, "check")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert json.loads(p.stdout)["stale"]
+
+
+def test_cli_exit_2_on_schema_garbage(tmp_path):
+    path = tmp_path / "ledger.jsonl"
+    path.write_text('{"schema": "v0-prehistoric", "metrics": {}}\n')
+    p = _run_cli("--ledger", str(path), "check")
+    assert p.returncode == 2
+    assert "schema error" in p.stderr
+    # missing ledger file is also a usage error, not a crash
+    p = _run_cli("--ledger", str(tmp_path / "nope.jsonl"), "check")
+    assert p.returncode == 2
+
+
+def test_cli_ingest_append_report_runs_without_jax(tmp_path):
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text(
+        "raise ImportError('perf_ledger must not import jax')\n")
+    env = {"PYTHONPATH": str(poison)}
+    path = str(tmp_path / "ledger.jsonl")
+    p = _run_cli("--ledger", path, "ingest", *_ARTIFACTS, env_extra=env)
+    assert p.returncode == 0, p.stderr
+    p = _run_cli("--ledger", path, "append",
+                 os.path.join(REPO, ".bench_serve_last.json"),
+                 env_extra=env)
+    assert p.returncode == 0, p.stderr
+    p = _run_cli("--ledger", path, "report", env_extra=env)
+    assert p.returncode == 0, p.stderr
+    assert "144.84" in p.stdout and "62.41" in p.stdout
+    p = _run_cli("--ledger", path, "report", "--format", "json",
+                 env_extra=env)
+    assert json.loads(p.stdout)["rows"] == 15
+    p = _run_cli("--ledger", path, "check", env_extra=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+def test_proxy_ratchet_on_committed_ledger():
+    """Tier-1 ratchet: chip-free proxy metrics (plan_capacity,
+    overlap_fraction, predicted step ms, ...) in the committed ledger
+    must never regress — the CI analogue of the tpu_lint zero-findings
+    guard."""
+    p = _run_cli("check", "--proxies-only")
+    assert p.returncode == 0, \
+        f"proxy metric regression in PERF_LEDGER.jsonl:\n{p.stdout}"
+    verdict = json.loads(p.stdout)
+    assert verdict["proxies_only"] and verdict["ok"]
+
+
+def test_bench_ledger_out_appends_error_row(tmp_path):
+    """bench.py --ledger-out writes a ledger row even when the bench
+    dies (chaos hook kills device init) — error rounds are history
+    too."""
+    path = str(tmp_path / "ledger.jsonl")
+    env = dict(os.environ)
+    env.update({"PTQ_CHAOS": "raise@device.init",
+                "PADDLE_TPU_BENCH_DEVICE_TIMEOUT": "1",
+                "PADDLE_TPU_BENCH_DEVICE_RETRY_DELAY": "0.1",
+                "JAX_PLATFORMS": "cpu"})
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--ledger-out", path],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert line, p.stdout + p.stderr
+    assert json.loads(line[-1])["error"]
+    with open(path) as f:
+        rows = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(rows) == 1
+    assert rows[0]["kind"] == "error"
+    assert rows[0]["provenance"]["cmd"].startswith("python bench.py")
