@@ -493,6 +493,121 @@ def run_multichip(n_devices=8, trace_out=None):
     return 0
 
 
+def multichip_gang_main(nproc, trace_out=None, steps=2):
+    """--multichip --gang N: the same llama pipeline preset, but run as
+    N REAL worker processes through ``python -m
+    paddle_tpu.distributed.launch`` (pp spans process boundaries over
+    the gloo CPU backend) instead of N virtual devices in one process.
+    Parses the per-rank ``GANG_RESULT`` lines out of the workerlogs and
+    folds them into one bench result whose ``detail.real_processes``
+    records the actual process count — the ledger row for a gang run is
+    distinguishable from a virtual-device run."""
+    import re
+    import subprocess
+    import tempfile
+
+    log_dir = tempfile.mkdtemp(prefix="bench_gang_")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc), "--max_restarts", "0",
+           "--log_dir", log_dir,
+           "--module", "paddle_tpu.distributed.gang",
+           "--steps", str(steps)]
+    if trace_out:
+        cmd += ["--trace-out", trace_out]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PTQ_CHAOS", None)  # never inherit chaos into a bench pod
+    # each worker must see exactly ONE local device: a stray
+    # host-platform-device-count flag would multiply the global device
+    # count and break the pp=world_size plan
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+\s*",
+                   " ", env.get("XLA_FLAGS", "")).strip()
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    timeout_s = float(os.environ.get("PADDLE_TPU_BENCH_TIMEOUT", "1000"))
+    _log(f"launching {nproc}-process gang pod (logs: {log_dir})")
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, env=env, cwd=_REPO, timeout=timeout_s,
+                          capture_output=True, text=True)
+    wall_s = time.monotonic() - t0
+
+    results = {}
+    for rank in range(nproc):
+        path = os.path.join(log_dir, f"workerlog.{rank}")
+        try:
+            with open(path) as f:
+                for ln in f:
+                    if ln.startswith("GANG_RESULT "):
+                        r = json.loads(ln[len("GANG_RESULT "):])
+                        results[r["rank"]] = r
+        except OSError:
+            pass
+    if proc.returncode != 0 or len(results) != nproc:
+        tail = (proc.stderr or proc.stdout or "")[-800:]
+        raise RuntimeError(
+            f"gang pod failed: rc={proc.returncode}, "
+            f"{len(results)}/{nproc} GANG_RESULT lines "
+            f"(logs: {log_dir})\n{tail}")
+
+    r0 = results[0]
+    losses0 = r0["losses"]
+    for rank, r in sorted(results.items()):
+        if r["losses"] != losses0:
+            raise RuntimeError(
+                f"rank {rank} loss trajectory diverged from rank 0: "
+                f"{r['losses']} != {losses0}")
+    # None = tracing off for that rank; False = recorded schedule
+    # diverged from the static model — a hard failure
+    matches = [r["matches_static"] for _, r in sorted(results.items())]
+    if any(m is False for m in matches):
+        raise RuntimeError(
+            f"recorded 1F1B schedule diverged from static model: "
+            f"per-rank matches_static={matches}")
+    step_ms = max(r["step_ms"] for r in results.values())
+    return {
+        "metric": "llama_train_multichip_step",
+        "value": round(step_ms, 2),
+        "unit": "ms_per_step",
+        "vs_baseline": None,  # no lockstep twin run in gang mode
+        "detail": {
+            "real_processes": nproc,
+            "plan": {"dims": r0["plan"], "schedule": r0["schedule"],
+                     "n_microbatches": r0["n_microbatches"],
+                     "overlap": r0["overlap"]},
+            "world_size": r0["world_size"],
+            "steps": r0["steps"],
+            "loss": losses0[-1] if losses0 else None,
+            "losses": losses0,
+            "step_ms_per_rank": {str(rank): r["step_ms"]
+                                 for rank, r in sorted(results.items())},
+            "matches_static": matches,
+            "pod_wall_s": round(wall_s, 2),
+            "log_dir": log_dir,
+        },
+    }
+
+
+def run_multichip_gang(nproc, trace_out=None, steps=2):
+    """--multichip --gang harness: same never-exit-silent contract."""
+    from paddle_tpu.runtime.watchdog import persist_incidents
+    try:
+        result = multichip_gang_main(nproc, trace_out=trace_out,
+                                     steps=steps)
+    except BaseException as e:  # noqa: BLE001 — the line must print
+        result = _error_result(str(e) or repr(e))
+        result["metric"] = "llama_train_multichip_step"
+        print(json.dumps(result))
+        sys.stdout.flush()
+        _ledger_append(result)
+        _persist_incidents_quietly(persist_incidents)
+        return 1
+    print(json.dumps(result))
+    _ledger_append(result)
+    return 0
+
+
 def _persist_incidents_quietly(persist_fn):
     """Flush the incident buffer before an os._exit path (which skips
     atexit) — the post-mortem sidecar must land even on a hang exit."""
@@ -615,6 +730,13 @@ if __name__ == "__main__":
                          "instead of the 1-chip MFU bench")
     ap.add_argument("--devices", type=int, default=8,
                     help="virtual device count for --multichip")
+    ap.add_argument("--gang", type=int, default=None, metavar="N",
+                    help="with --multichip: run the preset as N real "
+                         "worker processes through the launcher "
+                         "(pp crosses process boundaries) instead of "
+                         "N virtual devices in one process")
+    ap.add_argument("--gang-steps", type=int, default=2,
+                    help="train steps for the --gang pod (default 2)")
     ap.add_argument("--trace-out", default=None, metavar="DIR",
                     help="enable the flight recorder and write the "
                          "rank-tagged trace sidecar into DIR "
@@ -629,5 +751,8 @@ if __name__ == "__main__":
                          "tools/perf_ledger.py check)")
     cli = ap.parse_args()
     _LEDGER_OUT = cli.ledger_out
+    if cli.multichip and cli.gang:
+        sys.exit(run_multichip_gang(cli.gang, trace_out=cli.trace_out,
+                                    steps=cli.gang_steps))
     sys.exit(run_multichip(cli.devices, trace_out=cli.trace_out)
              if cli.multichip else run())

@@ -40,6 +40,7 @@ from . import rpc
 from . import auto_parallel
 from .launch_utils import spawn
 from . import launch
+from . import gang
 from . import ps
 
 __all__ = [
@@ -63,7 +64,7 @@ __all__ = [
     "fault_tolerance", "CheckpointManager", "PreemptionHandler",
     "reshard", "restore_resharded",
     "overlap", "Plan", "PlanError", "PlanCompilationError",
-    "PlanVerificationError",
+    "PlanVerificationError", "gang",
 ]
 
 

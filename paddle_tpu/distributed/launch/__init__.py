@@ -34,7 +34,30 @@ import sys
 import time
 from typing import List, Optional
 
-__all__ = ["LocalJob", "main"]
+__all__ = ["LocalJob", "main", "classify_exit"]
+
+
+def classify_exit(rc: Optional[int], escalated: bool = False) -> str:
+    """Classify one worker's terminal state for the pod incident record:
+
+    - ``clean``     — exit 0;
+    - ``relaunch``  — exit 101, the cooperative elastic-relaunch code
+      (``runtime.health.RELAUNCH_EXIT_CODE``): the worker detected a
+      failure, saved, and asked to be respawned;
+    - ``signal``    — killed by a signal (negative Popen returncode);
+    - ``abandoned`` — never exited on its own: the launcher had to
+      SIGKILL it (or it was still running when classified);
+    - ``failed``    — any other nonzero exit.
+    """
+    if escalated or rc is None:
+        return "abandoned"
+    if rc == 0:
+        return "clean"
+    if rc == 101:
+        return "relaunch"
+    if rc < 0:
+        return "signal"
+    return "failed"
 
 
 class _Worker:
@@ -55,7 +78,8 @@ class LocalJob:
                  master: Optional[str] = None, log_dir: str = "log",
                  job_id: str = "default", max_restarts: int = 3,
                  use_module: bool = False,
-                 heartbeat_timeout: Optional[float] = None):
+                 heartbeat_timeout: Optional[float] = None,
+                 teardown_grace: float = 5.0):
         self.script = script
         self.script_args = script_args
         self.nproc = nproc
@@ -64,9 +88,16 @@ class LocalJob:
         self.max_restarts = max_restarts
         self.use_module = use_module
         self.heartbeat_timeout = heartbeat_timeout
+        # failure teardown: how long surviving workers get to detect the
+        # failure themselves, final-save, and flush their incident/trace
+        # sidecars before the launcher starts signalling
+        self.teardown_grace = float(teardown_grace)
         self.restart_count = 0
         self._store = None
         self._monitor = None
+        # injectable for unit tests (no real sleeping/killing needed)
+        self._sleep = time.sleep
+        self._clock = time.monotonic
         if master:
             host, port = master.rsplit(":", 1)
             self.master_host, self.master_port = host, int(port)
@@ -95,6 +126,12 @@ class LocalJob:
             "PADDLE_JOB_ID": self.job_id,
             "PADDLE_RESTART_COUNT": str(self.restart_count),
         })
+        # each rank's incidents_rank<N>.jsonl lands next to its workerlog
+        # unless the operator pointed them somewhere explicitly; the
+        # single-file override must NOT be inherited (every rank would
+        # clobber the same path)
+        env.pop("PADDLE_TPU_INCIDENTS_OUT", None)
+        env.setdefault("PADDLE_TPU_INCIDENT_DIR", self.log_dir)
         os.makedirs(self.log_dir, exist_ok=True)
         log_path = os.path.join(self.log_dir, f"workerlog.{rank}")
         logf = open(log_path, "ab")
@@ -108,20 +145,62 @@ class LocalJob:
         logf.close()
         return _Worker(rank, proc, log_path)
 
-    def _kill_all(self, workers):
+    def _kill_all(self, workers, grace: Optional[float] = None,
+                  trigger: Optional[str] = None):
+        """Tear the gang down, classifying every worker's exit.
+
+        Escalation ladder: (1) an optional ``grace`` window in which
+        workers may exit VOLUNTARILY — survivors of a peer failure use
+        it to detect, final-save, and flush incident/trace sidecars
+        before exiting 101; (2) SIGTERM + 5s; (3) SIGKILL (the worker is
+        then classified ``abandoned``). Returns the per-worker exit
+        record list; when ``trigger`` is given, also records a
+        ``pod_teardown`` incident and persists the pod-level sidecar to
+        ``<log_dir>/pod_incidents.jsonl``."""
+        if grace:
+            deadline = self._clock() + grace
+            while (self._clock() < deadline
+                   and any(w.proc.poll() is None for w in workers)):
+                self._sleep(0.05)
         for w in workers:
             if w.proc.poll() is None:
                 try:
                     w.proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
-        deadline = time.time() + 5
+        escalated = set()
+        deadline = self._clock() + 5
         for w in workers:
             try:
-                w.proc.wait(max(0.1, deadline - time.time()))
+                w.proc.wait(max(0.1, deadline - self._clock()))
             except subprocess.TimeoutExpired:
+                escalated.add(w.rank)
                 w.proc.kill()
                 w.proc.wait()
+        exits = [{"rank": w.rank, "pid": w.proc.pid,
+                  "rc": w.proc.returncode,
+                  "class": classify_exit(w.proc.returncode,
+                                         escalated=w.rank in escalated)}
+                 for w in workers]
+        if trigger is not None:
+            from ...runtime.watchdog import (record_incident,
+                                             persist_incidents)
+            record_incident("pod_teardown", trigger=trigger,
+                            job_id=self.job_id,
+                            restart=self.restart_count,
+                            world_size=len(workers),
+                            grace_s=grace or 0.0, workers=exits)
+            pod_path = os.path.join(self.log_dir, "pod_incidents.jsonl")
+            # the launcher's atexit flush must also target the pod file,
+            # never a worker's incidents_rank<N>.jsonl (workers get a
+            # cleaned env from _spawn_one, so this does not leak down)
+            os.environ["PADDLE_TPU_INCIDENTS_OUT"] = pod_path
+            try:
+                persist_incidents(pod_path)
+            except OSError as exc:
+                sys.stderr.write(
+                    f"launch: pod incident persist failed: {exc}\n")
+        return exits
 
     def run(self, poll_interval: float = 0.2) -> int:
         """Run to completion with gang restart; returns the exit code."""
@@ -159,8 +238,10 @@ class LocalJob:
                         sys.stderr.write(
                             f"launch: rank {w.rank} exited rc={rc} "
                             f"(log: {w.log_path})\n")
-                        self._kill_all(workers)
-                        return rc
+                        exits = self._kill_all(
+                            workers, grace=self.teardown_grace,
+                            trigger=f"rank {w.rank} exited rc={rc}")
+                        return self._pod_rc(rc, exits)
                 if not alive:
                     return 0
                 if self._check_rescale():
@@ -175,14 +256,28 @@ class LocalJob:
                             f"launch: ranks {stale} heartbeat-stale "
                             f"(> {self.heartbeat_timeout}s): "
                             "declaring hung\n")
-                        self._kill_all(workers)
-                        return 1
+                        exits = self._kill_all(
+                            workers, grace=self.teardown_grace,
+                            trigger=f"ranks {stale} heartbeat-stale")
+                        return self._pod_rc(1, exits)
                 time.sleep(poll_interval)
         except BaseException:
             # ctrl-C, store errors from the rescale poll, anything: the
             # gang must never be orphaned behind a dead supervisor
             self._kill_all(workers)
             raise
+
+    @staticmethod
+    def _pod_rc(rc: int, exits) -> int:
+        """Pod exit code after a failure teardown. If ANY worker exited
+        with the cooperative relaunch code during the grace window (a
+        survivor that detected the failure, saved, and asked for a
+        respawn), the pod's verdict is 101 — the elastic supervisor then
+        relaunches without burning restart budget even when the
+        first-detected rc was a raw crash code."""
+        if any(e["class"] == "relaunch" for e in exits):
+            return 101
+        return rc
 
     def _check_rescale(self) -> bool:
         return False  # fixed-size pods never rescale
@@ -210,6 +305,11 @@ def main(argv=None) -> int:
                         help="declare a rank hung when its heartbeat "
                              "(fleet.elastic.start_heartbeat) stalls "
                              "this many seconds; hung pods gang-restart")
+    parser.add_argument("--teardown_grace", type=float, default=5.0,
+                        help="failure teardown: seconds surviving "
+                             "workers get to exit voluntarily (final "
+                             "save + incident/trace sidecar flush) "
+                             "before SIGTERM/SIGKILL escalation")
     parser.add_argument("--module", action="store_true",
                         help="run script as a python module (-m)")
     parser.add_argument("--elastic", action="store_true",
@@ -247,13 +347,15 @@ def main(argv=None) -> int:
                          job_id=args.job_id,
                          max_restarts=args.max_restarts,
                          use_module=args.module,
-                         heartbeat_timeout=args.heartbeat_timeout)
+                         heartbeat_timeout=args.heartbeat_timeout,
+                         teardown_grace=args.teardown_grace)
     else:
         job = LocalJob(args.script, args.script_args, args.nproc_per_node,
                        master=args.master, log_dir=args.log_dir,
                        job_id=args.job_id, max_restarts=args.max_restarts,
                        use_module=args.module,
-                       heartbeat_timeout=args.heartbeat_timeout)
+                       heartbeat_timeout=args.heartbeat_timeout,
+                       teardown_grace=args.teardown_grace)
     try:
         return job.run()
     finally:

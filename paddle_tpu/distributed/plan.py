@@ -94,6 +94,28 @@ def _error_findings(findings):
     return [f for f in findings if getattr(f, "severity", "") == "error"]
 
 
+def _put_global(arr, sharding):
+    """Place one host array under ``sharding`` — single- OR multi-process
+    safe. ``jax.device_put`` can only target addressable devices; in a
+    real gang every rank materializes the same deterministic global host
+    array and contributes just its addressable shards via
+    ``make_array_from_callback`` (the standard multi-controller feeding
+    pattern)."""
+    import jax
+    import numpy as np
+    arr = np.asarray(arr)
+    # match device_put's dtype canonicalization (int64 -> int32 with x64
+    # off); make_array_from_callback feeds raw host bytes to XLA, where
+    # a non-canonical dtype corrupts the runtime instead of downcasting
+    canon = jax.dtypes.canonicalize_dtype(arr.dtype)
+    if arr.dtype != canon:
+        arr = arr.astype(canon)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx, _a=arr: _a[idx])
+
+
 def _wrap_step_tracing(plan: "Plan", step_fn: Callable) -> Callable:
     """Per-rank train-step spans for the flight recorder.
 
@@ -420,7 +442,8 @@ class Plan:
                        devices=None, optimizer=None, rng=None,
                        job_id: str = "plan", scale_store=None,
                        ckpt_root: Optional[str] = None,
-                       verify: Optional[bool] = None):
+                       verify: Optional[bool] = None,
+                       on_step: Optional[Callable] = None):
         """Plan-driven training loop with elastic resize.
 
         Before each step the loop polls ``scale_store`` for the
@@ -433,6 +456,11 @@ class Plan:
         Returns ``{"losses", "world_sizes", "resizes"}`` (one entry per
         step; ``resizes`` records ``(step_index, old_world, new_world)``
         tuples).
+
+        ``on_step(step_count, params, opt_state)`` fires after every
+        completed step with the 1-based step count and the live state —
+        the gang runtime's step-boundary hook (health step stamp +
+        final-save snapshot + beacon).
         """
         import jax
         import numpy as np
@@ -472,8 +500,7 @@ class Plan:
                 lambda x: np.asarray(getattr(x, "_array", x)),
                 state, is_leaf=lambda x: isinstance(x, Tensor))
             return jax.tree_util.tree_map(
-                lambda x, a: jax.device_put(np.asarray(x), a.sharding),
-                state, abstract)
+                lambda x, a: _put_global(x, a.sharding), state, abstract)
 
         history = {"losses": [], "world_sizes": [], "resizes": []}
         step_idx = 0
@@ -508,13 +535,14 @@ class Plan:
                 opt_state = _place_like(state["opt_state"], o_abs)
                 history["resizes"].append((step_idx, old_world, want))
             sh = NamedSharding(topo.mesh, P(topo.batch_axes, None))
-            placed = {k: jax.device_put(np.asarray(v), sh)
-                      for k, v in batch.items()}
+            placed = {k: _put_global(v, sh) for k, v in batch.items()}
             params, opt_state, metrics = step_fn(params, opt_state,
                                                  placed)
             history["losses"].append(float(metrics["loss"]))
             history["world_sizes"].append(plan.world_size)
             step_idx += 1
+            if on_step is not None:
+                on_step(step_idx, params, opt_state)
             _train_status.update(step=step_idx,
                                  loss=history["losses"][-1],
                                  world_size=plan.world_size)

@@ -39,7 +39,7 @@ __all__ = [
     "slice_for_shard", "mesh_coords_iter", "reslice", "gather_full",
     "topology_block", "sharding_specs", "rng_bundle", "apply_rng_bundle",
     "manifest_extra", "apply_manifest_state", "place", "place_tree",
-    "restore_resharded",
+    "restore_resharded", "host_full",
 ]
 
 
@@ -313,6 +313,38 @@ def apply_manifest_state(man: dict, *, data=None, rng: bool = False,
         apply_rng_bundle(bundle)
         applied["rng"] = True
     return applied
+
+
+def host_full(leaf) -> np.ndarray:
+    """Full host array from a (possibly multi-process) ``jax.Array``
+    using ONLY this process's addressable shards — no collectives, so it
+    is safe on the failure path where peers may already be dead.
+
+    Fully-addressable arrays (every single-process array, and replicated
+    params in a gang) fetch directly. A cross-process array works iff
+    this rank's shards cover the whole index space (replicated or
+    batch-sharded-only leaves); a leaf whose data partly lives on a
+    PEER process raises ``ValueError`` — that state is physically
+    unrecoverable from one rank."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is None or getattr(leaf, "is_fully_addressable", True):
+        return np.asarray(leaf)
+    out = np.empty(tuple(leaf.shape), dtype=leaf.dtype)
+    covered = 0
+    seen = set()
+    for s in shards:
+        data = np.asarray(s.data)
+        out[s.index] = data
+        key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+        if key not in seen:
+            seen.add(key)
+            covered += data.size
+    if covered < out.size:
+        raise ValueError(
+            f"array of shape {tuple(leaf.shape)} is not reconstructible "
+            f"from this process's shards ({covered}/{out.size} elements "
+            f"addressable): its sharding places data on peer processes")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,12 @@ class _NativeStore:
         L = native.lib()
         self._lib = L
         self._server = None
+        # ONE socket per client: every op is a request/response exchange
+        # on it, so concurrent callers (main thread + health-monitor
+        # beats + fleet heartbeat thread in a gang worker) must be
+        # serialized or the framing interleaves — the observed failure
+        # is a garbled length prefix read as a huge allocation size
+        self._oplock = threading.Lock()
         import ctypes
         if is_master:
             out_port = ctypes.c_int(0)
@@ -54,8 +60,10 @@ class _NativeStore:
             raise TimeoutError(f"TCPStore connect to {host}:{port} failed")
 
     def set(self, key: str, value: bytes):
-        if self._lib.ptq_store_set(self._h, key.encode(), value,
-                                   len(value)) < 0:
+        with self._oplock:
+            rc = self._lib.ptq_store_set(self._h, key.encode(), value,
+                                         len(value))
+        if rc < 0:
             raise IOError("TCPStore.set failed")
 
     def _get(self, fn, key):
@@ -63,7 +71,8 @@ class _NativeStore:
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)
-            n = fn(self._h, key.encode(), buf, cap)
+            with self._oplock:
+                n = fn(self._h, key.encode(), buf, cap)
             if n == -2:
                 cap *= 16
                 continue
@@ -98,21 +107,24 @@ class _NativeStore:
             poll_s = min(poll_s * 2, 0.25)
 
     def add(self, key: str, delta: int = 1) -> int:
-        v = self._lib.ptq_store_add(self._h, key.encode(), delta)
+        with self._oplock:
+            v = self._lib.ptq_store_add(self._h, key.encode(), delta)
         if v == -(2 ** 63):
             raise IOError("TCPStore.add failed")
         return int(v)
 
     def delete(self, key: str) -> bool:
-        return self._lib.ptq_store_delete(self._h, key.encode()) > 0
+        with self._oplock:
+            return self._lib.ptq_store_delete(self._h, key.encode()) > 0
 
     def close(self):
-        if self._h:
-            self._lib.ptq_store_disconnect(self._h)
-            self._h = None
-        if self._server:
-            self._lib.ptq_store_server_stop(self._server)
-            self._server = None
+        with self._oplock:
+            if self._h:
+                self._lib.ptq_store_disconnect(self._h)
+                self._h = None
+            if self._server:
+                self._lib.ptq_store_server_stop(self._server)
+                self._server = None
 
 
 class _PyStore:
@@ -247,13 +259,48 @@ class TCPStore:
     def delete_key(self, key: str) -> bool:
         return self._impl.delete(key)
 
-    def barrier(self, name: str = "barrier", rank: int = 0,
-                poll_s: float = 0.01):
-        """All world_size ranks block until everyone arrived."""
+    def barrier(self, name: str = "barrier", rank: Optional[int] = None,
+                poll_s: float = 0.01, timeout: Optional[float] = None):
+        """All world_size ranks block until everyone arrived.
+
+        Each rank stamps a per-rank arrival key before bumping the
+        shared counter, so a timeout can NAME the ranks that never
+        showed up (the one diagnostic that matters when a pod wedges at
+        rendezvous) instead of raising a bare TimeoutError. ``rank``
+        defaults to ``PADDLE_TRAINER_ID`` — the launcher sets it in
+        every worker."""
+        if rank is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.set(f"__bar_in__{name}/{rank}", b"1")
         n = self.add(f"__bar__{name}", 1)
         if n == self.world_size:
             self.set(f"__bar_done__{name}", b"1")
-        self.wait(f"__bar_done__{name}")
+        try:
+            self.wait(f"__bar_done__{name}", timeout)
+        except TimeoutError:
+            missing = self.barrier_missing(name)
+            budget = self.timeout if timeout is None else timeout
+            from ..runtime.watchdog import record_incident
+            record_incident("store_barrier_timeout", barrier=name,
+                            rank=rank, world_size=self.world_size,
+                            timeout_s=round(float(budget), 3),
+                            missing=missing)
+            raise TimeoutError(
+                f"store barrier {name!r} timed out after {budget:.1f}s: "
+                f"rank {rank} waited for {self.world_size} ranks but "
+                f"ranks {missing} never arrived") from None
+
+    def barrier_missing(self, name: str) -> list:
+        """Ranks with no arrival stamp for barrier ``name`` (diagnostic
+        read — best-effort, never raises)."""
+        missing = []
+        for r in range(self.world_size):
+            try:
+                if self.get(f"__bar_in__{name}/{r}") is None:
+                    missing.append(r)
+            except Exception:  # tpu-lint: disable=except-pass
+                missing.append(r)
+        return missing
 
     def close(self):
         self._impl.close()

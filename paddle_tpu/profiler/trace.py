@@ -46,7 +46,7 @@ __all__ = [
     "TraceRecorder", "record_pipeline_schedule", "pipeline_schedule_events",
     "request_timeline", "TERMINAL_PHASES", "write_sidecar", "read_sidecar",
     "merge_ranks", "merge_sidecars", "chrome_events", "sidecar_path",
-    "SCHEMA",
+    "SCHEMA", "TERMINAL_BARRIER",
 ]
 
 # Same discipline as profiler.metrics: the disabled path must cost one
@@ -56,6 +56,11 @@ _FLAG_NAME = "FLAGS_tpu_trace"
 
 SCHEMA = "paddle_tpu.trace.v1"
 TERMINAL_PHASES = ("finish", "cancelled", "failed")
+
+# Barrier every gang rank records immediately before writing its final
+# sidecar — its presence in a rank's sidecar proves the rank reached
+# orderly teardown (trace_report --gang checks for it per rank).
+TERMINAL_BARRIER = "gang/exit"
 
 _DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_TRACE_RING_CAP",
                                        "65536") or 65536)

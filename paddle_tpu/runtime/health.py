@@ -179,6 +179,7 @@ class HealthMonitor:
         coll = self._coll
         payload = {"n": self._beat_n, "step": self._step,
                    "phase": self._phase, "t": time.time(),
+                   "pid": os.getpid(),
                    "coll": ({"op": coll["op"], "seq": coll["seq"],
                              "since": coll["since"]} if coll else None)}
         try:
@@ -271,6 +272,7 @@ class HealthMonitor:
                 self.dead.add(peer)
                 found.append(record_incident(
                     "rank_dead", peer=peer, step=payload.get("step"),
+                    peer_pid=payload.get("pid"),
                     silent_s=round(silent, 3),
                     timeout_s=self.heartbeat_timeout))
                 self._metric("health_rank_dead_total", peer=str(peer))
@@ -396,6 +398,7 @@ class HealthMonitor:
 
     def stats(self) -> Dict[str, Any]:
         return {"rank": self.rank, "world_size": self.world_size,
+                "pid": os.getpid(),
                 "beats": self._beat_n, "step": self._step,
                 "dead": sorted(self.dead),
                 "stragglers": sorted(self.stragglers),

@@ -103,7 +103,8 @@ def record_incident(kind: str, **fields) -> Dict[str, Any]:
     ``tools/trace_report.py --incidents`` post-mortems (exit-101 paths
     bypass atexit and call :func:`persist_incidents` explicitly)."""
     rec = {"kind": kind, "time": time.time(),
-           "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0"))}
+           "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+           "pid": os.getpid()}
     rec.update(fields)
     global _PERSIST_REGISTERED
     with _INCIDENTS_LOCK:

@@ -68,7 +68,8 @@ from typing import Iterable, List, Optional, Union
 
 __all__ = ["Chaos", "ChaosError", "ReplicaKilled", "Rule", "chaos_point",
            "install", "uninstall", "active", "installed",
-           "install_from_env", "truncate_file", "corrupt_file"]
+           "install_from_env", "truncate_file", "corrupt_file",
+           "set_kill_mode", "kill_mode"]
 
 ACTIONS = ("crash", "raise", "sigterm", "hang", "stall", "disconnect",
            "truncate", "fail", "kill", "exhaust")
@@ -76,6 +77,27 @@ ACTIONS = ("crash", "raise", "sigterm", "hang", "stall", "disconnect",
 # injectable so infinite-hang tests can count chunks instead of sleeping
 _SLEEP = time.sleep
 _HANG_CHUNK_S = 60.0
+
+# How the `kill` action dies. "raise" (default) raises ReplicaKilled so
+# in-process harnesses (the serving router failover tests) can catch it;
+# "process" calls os._exit(exit_code) — sudden whole-process death with
+# no flush, no atexit — which is what a real gang peer loss looks like.
+# The gang runtime switches to "process" at init.
+_KILL_MODE = "raise"
+
+
+def set_kill_mode(mode: str) -> None:
+    """Select ``kill`` semantics: ``"raise"`` (ReplicaKilled, in-process
+    harnesses) or ``"process"`` (``os._exit`` — real peer death)."""
+    global _KILL_MODE
+    if mode not in ("raise", "process"):
+        raise ValueError(f"kill mode must be 'raise' or 'process', "
+                         f"got {mode!r}")
+    _KILL_MODE = mode
+
+
+def kill_mode() -> str:
+    return _KILL_MODE
 
 
 class ChaosError(RuntimeError):
@@ -237,6 +259,11 @@ class Chaos:
             raise ChaosError(f"chaos: injected crash at {point} "
                              f"(step={step})")
         if r.action == "kill":
+            if _KILL_MODE == "process":
+                # gang semantics: the peer vanishes mid-collective with
+                # nothing flushed — survivors must detect via heartbeat
+                # silence, not via an exception propagating anywhere
+                os._exit(r.exit_code)
             raise ReplicaKilled(f"chaos: replica killed at {point} "
                                 f"(step={step})")
         if r.action == "exhaust":

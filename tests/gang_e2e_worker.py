@@ -1,0 +1,122 @@
+"""Exact-arithmetic gang worker for the multi-process E2E tests.
+
+Trains a tiny linear model under the real gang runtime
+(``distributed.gang``) with every floating-point operation EXACT:
+integer data in {-1, 0, 1}, float64 weights quantized to the 2^-12
+dyadic grid each step, a power-of-two global batch and learning rate.
+Every intermediate is a dyadic rational well inside float64's mantissa,
+so sums are order-independent and the loss trajectory is bit-identical
+at ANY world size — the oracle the kill/hang E2Es need to prove that a
+chaos-interrupted 4-process run, final-saved by the survivors and
+relaunched at world 2 through ``restore_resharded``, resumes the exact
+trajectory of an uninterrupted reference.
+
+Per completed step the worker prints one line::
+
+    E2E_STEP {"restart": R, "rank": k, "world": W, "step": n,
+              "loss": <float64 repr>, "ids": [global sample ids]}
+
+and on clean completion ``E2E_DONE {"rank": k, "restart": R}``. The
+test harness assembles the trajectory from these lines across
+generations and compares it bit-for-bit against the reference run.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+GRID = 4096.0    # 2^12 quantization grid for the weights
+LR = 2.0 ** -6
+DIM = 4
+
+
+def make_batch(step: int, batch: int):
+    """Deterministic integer batch for 1-based ``step``: global sample
+    ids and features/targets in {-1, 0, 1} derived from them."""
+    import numpy as np
+    ids = np.arange((step - 1) * batch, step * batch, dtype=np.int64)
+    x = np.stack([((ids * (k + 2) + k) % 3) - 1 for k in range(DIM)],
+                 axis=1).astype(np.float64)
+    y = ((ids % 3) - 1).astype(np.float64)
+    return ids, x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--ckpt-root", required=True)
+    args = p.parse_args()
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import gang
+    ctx = gang.init_gang(gang.GangConfig.from_env(
+        ckpt_root=args.ckpt_root))
+
+    from paddle_tpu.distributed.mesh import get_topology
+    from paddle_tpu.distributed.plan import _put_global
+    from paddle_tpu.distributed.reshard import restore_resharded
+
+    topo = get_topology()
+    mesh = topo.mesh
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P(topo.batch_axes))
+    bsh2 = NamedSharding(mesh, P(topo.batch_axes, None))
+
+    @jax.jit
+    def step_fn(w, x, y):
+        def loss_fn(w):
+            r = x @ w - y
+            return (r @ r) / x.shape[0]
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        w = w - LR * g
+        # requantize to the dyadic grid: the pre-rounding value is exact
+        # (order-independent), so the rounded weights are identical at
+        # every world size and across save/restore boundaries
+        return jnp.round(w * GRID) / GRID, loss
+
+    state, start = restore_resharded(args.ckpt_root, mesh=mesh)
+    if state is None:
+        w = _put_global(np.zeros((DIM,), np.float64), repl)
+    else:
+        # the pickle restore wraps leaves in the eager Tensor facade (a
+        # pytree node) — unwrap to raw arrays before feeding the jitted
+        # step (same dance as plan._place_like)
+        from paddle_tpu.core.tensor import Tensor
+        w = jax.tree_util.tree_map(
+            lambda a: _put_global(
+                np.asarray(getattr(a, "_array", a)), repl),
+            state["params"], is_leaf=lambda x: isinstance(x, Tensor))
+
+    with ctx.running():
+        for step in range(start + 1, args.steps + 1):
+            ids, x, y = make_batch(step, args.batch)
+            xg = _put_global(x, bsh2)
+            yg = _put_global(y, bsh)
+            w, loss = step_fn(w, xg, yg)
+            print("E2E_STEP " + json.dumps({
+                "restart": ctx.restart, "rank": ctx.rank,
+                "world": ctx.world_size, "step": step,
+                "loss": float(loss), "ids": ids.tolist(),
+            }, sort_keys=True), flush=True)
+            # the gang step boundary: health step stamp, final-save
+            # snapshot handover, and the collective.all_reduce chaos
+            # injection point the kill/hang E2Es target
+            ctx.step_boundary(step, w, {"step": step})
+
+    print("E2E_DONE " + json.dumps(
+        {"rank": ctx.rank, "restart": ctx.restart}), flush=True)
+    ctx.shutdown(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

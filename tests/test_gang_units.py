@@ -1,0 +1,348 @@
+"""Fast unit tests for the multi-process gang runtime pieces.
+
+Everything here runs in-process with injected clocks/fakes — the real
+cross-process kill/hang E2Es live in ``test_gang_runtime.py`` (slow
+tier). Covered:
+
+* ``store.TCPStore.barrier`` timeout diagnostics naming the missing
+  ranks;
+* ``launch.classify_exit`` and the ``LocalJob._kill_all`` escalation
+  ladder (grace -> SIGTERM -> SIGKILL) with fake workers and a fake
+  clock, including the ``pod_teardown`` incident sidecar;
+* ``tools/trace_report.py --gang``: the stdlib re-implementation of the
+  1F1B schedule model against the real ``overlap.schedule_events``, and
+  the merged multi-rank verdict on synthetic sidecar fixtures
+  (pass / missing rank / missing terminal barrier / tick divergence).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import threading
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_tool",
+        os.path.join(_REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# classify_exit
+# ---------------------------------------------------------------------------
+
+def test_classify_exit():
+    from paddle_tpu.distributed.launch import classify_exit
+    assert classify_exit(0) == "clean"
+    assert classify_exit(101) == "relaunch"
+    assert classify_exit(-15) == "signal"
+    assert classify_exit(-9) == "signal"
+    assert classify_exit(42) == "failed"
+    assert classify_exit(1) == "failed"
+    assert classify_exit(None) == "abandoned"
+    # a SIGKILL escalation overrides whatever rc the kill produced
+    assert classify_exit(-9, escalated=True) == "abandoned"
+    assert classify_exit(0, escalated=True) == "abandoned"
+
+
+# ---------------------------------------------------------------------------
+# _kill_all escalation ladder (fake workers, fake clock)
+# ---------------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+class _FakeProc:
+    """Popen-alike driven by the fake clock.
+
+    ``exits_at``: clock time at which the worker exits voluntarily with
+    ``rc``. ``obeys_sigterm``: SIGTERM makes it exit rc -15; otherwise
+    it ignores SIGTERM and only SIGKILL (``kill``) takes it down.
+    """
+
+    def __init__(self, clock, pid, exits_at=None, rc=101,
+                 obeys_sigterm=True):
+        self._clock = clock
+        self.pid = pid
+        self._exits_at = exits_at
+        self._rc = rc
+        self._obeys_sigterm = obeys_sigterm
+        self.returncode = None
+        self.signals = []
+
+    def poll(self):
+        if (self.returncode is None and self._exits_at is not None
+                and self._clock() >= self._exits_at):
+            self.returncode = self._rc
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if self._obeys_sigterm:
+            self.returncode = -15
+
+    def wait(self, timeout=None):
+        if self.poll() is not None:
+            return self.returncode
+        raise subprocess.TimeoutExpired(cmd="fake", timeout=timeout)
+
+    def kill(self):
+        self.signals.append("KILL")
+        self.returncode = -9
+
+
+class _W:
+    def __init__(self, rank, proc):
+        self.rank = rank
+        self.proc = proc
+        self.log_path = f"workerlog.{rank}"
+
+
+def _make_job(tmp_path):
+    from paddle_tpu.distributed.launch import LocalJob
+    job = LocalJob(script="noop.py", script_args=[], nproc=2,
+                   log_dir=str(tmp_path))
+    clock = _FakeClock()
+    job._clock = clock
+    job._sleep = clock.sleep
+    return job, clock
+
+
+def test_kill_all_grace_lets_survivors_exit_voluntarily(tmp_path):
+    job, clock = _make_job(tmp_path)
+    # both workers notice the failure themselves and exit 101 inside
+    # the grace window: the launcher must never signal them
+    workers = [_W(r, _FakeProc(clock, 100 + r, exits_at=0.3))
+               for r in range(2)]
+    exits = job._kill_all(workers, grace=5.0)
+    assert [e["class"] for e in exits] == ["relaunch", "relaunch"]
+    assert all(w.proc.signals == [] for w in workers)
+    assert clock.t < 5.0  # grace loop ends as soon as everyone is gone
+
+
+def test_kill_all_escalates_to_sigterm_then_sigkill(tmp_path):
+    job, clock = _make_job(tmp_path)
+    polite = _W(0, _FakeProc(clock, 100, obeys_sigterm=True))
+    stubborn = _W(1, _FakeProc(clock, 101, obeys_sigterm=False))
+    exits = job._kill_all([polite, stubborn], grace=0.5)
+    by_rank = {e["rank"]: e for e in exits}
+    assert by_rank[0]["class"] == "signal"       # died on SIGTERM
+    assert by_rank[1]["class"] == "abandoned"    # needed SIGKILL
+    assert "KILL" in stubborn.proc.signals
+    assert "KILL" not in polite.proc.signals
+
+
+def test_kill_all_trigger_writes_pod_incident(tmp_path):
+    job, clock = _make_job(tmp_path)
+    dead = _FakeProc(clock, 100, exits_at=0.0, rc=42)
+    alive = _FakeProc(clock, 101, obeys_sigterm=True)
+    prior = os.environ.get("PADDLE_TPU_INCIDENTS_OUT")
+    try:
+        job._kill_all([_W(0, dead), _W(1, alive)], grace=0.2,
+                      trigger="worker_failure")
+    finally:
+        if prior is None:
+            os.environ.pop("PADDLE_TPU_INCIDENTS_OUT", None)
+        else:
+            os.environ["PADDLE_TPU_INCIDENTS_OUT"] = prior
+    pod_path = tmp_path / "pod_incidents.jsonl"
+    assert pod_path.exists()
+    recs = [json.loads(ln) for ln in
+            pod_path.read_text().splitlines()[1:]]
+    teardowns = [r for r in recs if r.get("kind") == "pod_teardown"]
+    assert teardowns, recs
+    td = teardowns[-1]
+    assert td["trigger"] == "worker_failure"
+    classes = {w["rank"]: w["class"] for w in td["workers"]}
+    assert classes[0] == "failed"   # the chaos-killed worker (rc 42)
+    assert classes[1] == "signal"   # torn down by the launcher
+
+
+# ---------------------------------------------------------------------------
+# barrier timeout diagnostics
+# ---------------------------------------------------------------------------
+
+def test_barrier_timeout_names_missing_ranks():
+    from paddle_tpu.distributed.store import TCPStore
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=3,
+                     timeout=30.0)
+    try:
+        errs = {}
+
+        def arrive(rank):
+            try:
+                store.barrier("boot", rank=rank, timeout=1.0)
+            except TimeoutError as e:
+                errs[rank] = str(e)
+
+        threads = [threading.Thread(target=arrive, args=(r,))
+                   for r in (0, 1)]  # rank 2 never shows up
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(errs) == [0, 1]
+        for rank, msg in errs.items():
+            assert "ranks [2]" in msg, msg
+            assert "boot" in msg
+        assert store.barrier_missing("boot") == [2]
+        from paddle_tpu.runtime.watchdog import incidents
+        recs = [r for r in incidents()
+                if r.get("kind") == "store_barrier_timeout"
+                and r.get("barrier") == "boot"]
+        assert recs and recs[-1]["missing"] == [2]
+    finally:
+        store.close()
+
+
+def test_barrier_completes_when_all_arrive():
+    from paddle_tpu.distributed.store import TCPStore
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=3,
+                     timeout=30.0)
+    try:
+        done = []
+
+        def arrive(rank):
+            store.barrier("full", rank=rank, timeout=30.0)
+            done.append(rank)
+
+        threads = [threading.Thread(target=arrive, args=(r,))
+                   for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert sorted(done) == [0, 1, 2]
+        assert store.barrier_missing("full") == []
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# trace_report --gang
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,n_micro,overlap", [
+    (1, 1, False), (2, 4, False), (2, 4, True),
+    (4, 8, False), (4, 8, True), (3, 5, True),
+])
+def test_static_schedule_matches_overlap_model(pp, n_micro, overlap):
+    """The tool's stdlib schedule re-implementation must be bit-equal,
+    dict-for-dict and in order, with the real simulator — this is the
+    drift guard that lets the verdict run without importing paddle_tpu."""
+    from paddle_tpu.distributed.overlap import schedule_events
+    tr = _load_trace_report()
+    assert tr.static_schedule(pp, n_micro, overlap) == \
+        schedule_events(pp, n_micro, overlap=overlap)
+
+
+def _write_gang_sidecar(path, rank, world=2, schedule=True,
+                        terminal=True, tamper_tick=False):
+    from paddle_tpu.distributed.overlap import schedule_events
+    events = []
+    if schedule:
+        sched = schedule_events(2, 4, overlap=True)
+        if tamper_tick:
+            sched = [dict(e) for e in sched]
+            sched[0]["tick"] += 1
+        events.append({"name": "pipeline/schedule",
+                       "kind": "pipeline_meta", "t": 0.0, "pp": 2,
+                       "n_micro": 4, "overlap": True})
+        events += [{"name": f"pipeline/{e['kind']}", "kind": "pipeline",
+                    "t": 0.0, "ev": e} for e in sched]
+    if terminal:
+        events.append({"name": "gang/exit", "kind": "barrier", "t": 1.0,
+                       "status": "ok", "step": 2})
+    header = {"schema": "paddle_tpu.trace.v1", "rank": rank, "pid": 1,
+              "wall_time": 0.0, "dropped": 0, "world_size": world,
+              "restart": 0, "status": "ok"}
+    with open(path, "w") as f:
+        for rec in [header] + events:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _gang_verdict(tr, d, capsys):
+    rc = tr.main(["--gang", str(d)])
+    report = json.loads(capsys.readouterr().out)
+    return rc, report
+
+
+def test_gang_verdict_pass(tmp_path, capsys):
+    tr = _load_trace_report()
+    for r in range(2):
+        _write_gang_sidecar(tmp_path / f"trace_rank{r}.jsonl", r)
+    rc, report = _gang_verdict(tr, tmp_path, capsys)
+    assert rc == 0
+    assert report["verdict"] == "pass"
+    assert report["world_size"] == 2
+    assert all(row["terminal_barrier"] for row in report["per_rank"])
+    assert all(row["schedule"]["matches_static"]
+               for row in report["per_rank"])
+
+
+def test_gang_verdict_missing_rank(tmp_path, capsys):
+    tr = _load_trace_report()
+    _write_gang_sidecar(tmp_path / "trace_rank0.jsonl", 0)  # world=2
+    rc, report = _gang_verdict(tr, tmp_path, capsys)
+    assert rc == 1
+    assert report["missing_ranks"] == [1]
+    assert any("missing sidecar" in f for f in report["failures"])
+
+
+def test_gang_verdict_missing_terminal_barrier(tmp_path, capsys):
+    tr = _load_trace_report()
+    _write_gang_sidecar(tmp_path / "trace_rank0.jsonl", 0,
+                        terminal=False)
+    _write_gang_sidecar(tmp_path / "trace_rank1.jsonl", 1)
+    rc, report = _gang_verdict(tr, tmp_path, capsys)
+    assert rc == 1
+    assert any("terminal barrier" in f for f in report["failures"])
+    by_rank = {row["rank"]: row for row in report["per_rank"]}
+    assert by_rank[0]["terminal_barrier"] is False
+    assert by_rank[1]["terminal_barrier"] is True
+
+
+def test_gang_verdict_schedule_divergence(tmp_path, capsys):
+    tr = _load_trace_report()
+    _write_gang_sidecar(tmp_path / "trace_rank0.jsonl", 0)
+    _write_gang_sidecar(tmp_path / "trace_rank1.jsonl", 1,
+                        tamper_tick=True)
+    rc, report = _gang_verdict(tr, tmp_path, capsys)
+    assert rc == 1
+    assert any("diverges from the static model" in f
+               for f in report["failures"])
+    by_rank = {row["rank"]: row for row in report["per_rank"]}
+    assert by_rank[1]["schedule"]["matches_static"] is False
+    assert "divergence" in by_rank[1]["schedule"]
+
+
+def test_gang_verdict_empty_dir_is_error(tmp_path, capsys):
+    tr = _load_trace_report()
+    rc, report = _gang_verdict(tr, tmp_path, capsys)
+    assert rc == 2
+    assert report["errors"]
+
+
+def test_gang_verdict_pp1_run_has_no_schedule_check(tmp_path, capsys):
+    # a pure-DP gang records no pipeline schedule: that is not a failure
+    tr = _load_trace_report()
+    _write_gang_sidecar(tmp_path / "trace_rank0.jsonl", 0, world=1,
+                        schedule=False)
+    rc, report = _gang_verdict(tr, tmp_path, capsys)
+    assert rc == 0
+    assert report["per_rank"][0]["schedule"] is None

@@ -405,6 +405,189 @@ def write_black_box(out_path: str, trace_files: List[str],
 
 
 # ---------------------------------------------------------------------------
+# gang verdict (--gang)
+# ---------------------------------------------------------------------------
+
+GANG_TERMINAL_BARRIER = "gang/exit"
+
+
+def _f_tick(s: int, m: int, overlap: bool) -> int:
+    return (2 * s if overlap else s) + m
+
+
+def _b_tick(s: int, m: int, pp: int, overlap: bool) -> int:
+    if overlap:
+        return 4 * (pp - 1) + 1 - 2 * s + m
+    return 2 * pp - 1 - s + m
+
+
+def static_schedule(pp: int, n_micro: int, overlap: bool) -> List[dict]:
+    """The 1F1B static schedule model, re-implemented verbatim from
+    ``distributed.overlap.schedule_events`` (F/B tick arithmetic, edge
+    ticks, and the simulator's sort key) so the gang verdict needs no
+    paddle_tpu import and the comparison is bit-equal dict-for-dict."""
+    events: List[dict] = []
+    for m in range(n_micro):
+        for s in range(pp):
+            tf = _f_tick(s, m, overlap)
+            tb = _b_tick(s, m, pp, overlap)
+            events.append({"kind": "fwd", "tick": tf, "stage": s,
+                           "micro": m})
+            events.append({"kind": "bwd", "tick": tb, "stage": s,
+                           "micro": m})
+            if s < pp - 1:
+                events.append({
+                    "kind": "send_fwd", "micro": m, "src": s, "dst": s + 1,
+                    "tick": tf + 1 if overlap else tf,
+                    "produced_tick": tf,
+                    "consumed_tick": _f_tick(s + 1, m, overlap)})
+            if s > 0:
+                events.append({
+                    "kind": "send_bwd", "micro": m, "src": s, "dst": s - 1,
+                    "tick": tb + 1 if overlap else tb,
+                    "produced_tick": tb,
+                    "consumed_tick": _b_tick(s - 1, m, pp, overlap)})
+    events.sort(key=lambda e: (e["tick"], e["stage"] if "stage" in e
+                               else e["src"]))
+    return events
+
+
+def _rank_schedule_verdict(events: List[dict]) -> Optional[dict]:
+    """Compare every pipeline-schedule recording in one rank's event
+    stream against the static model. None when the rank recorded no
+    schedule (pp == 1 runs legitimately record none)."""
+    recordings: List[dict] = []
+    current: Optional[dict] = None
+    for e in events:
+        if e.get("kind") == "pipeline_meta" and "pp" in e:
+            current = {"pp": int(e["pp"]), "n_micro": int(e["n_micro"]),
+                       "overlap": bool(e["overlap"]), "sched": []}
+            recordings.append(current)
+        elif e.get("kind") == "pipeline" and "ev" in e:
+            if current is not None:
+                current["sched"].append(dict(e["ev"]))
+    if not recordings:
+        return None
+    out = {"recordings": len(recordings), "matches_static": True}
+    for i, rec in enumerate(recordings):
+        recorded = sorted(rec["sched"],
+                          key=lambda e: (e["tick"],
+                                         e["stage"] if "stage" in e
+                                         else e["src"]))
+        static = static_schedule(rec["pp"], rec["n_micro"],
+                                 rec["overlap"])
+        out.setdefault("pp", rec["pp"])
+        out.setdefault("n_micro", rec["n_micro"])
+        out.setdefault("overlap", rec["overlap"])
+        if recorded == static:
+            continue
+        out["matches_static"] = False
+        div = {"recording": i, "recorded_events": len(recorded),
+               "static_events": len(static)}
+        for j, (a, b) in enumerate(zip(recorded, static)):
+            if a != b:
+                div.update(index=j, recorded=a, static=b)
+                break
+        else:
+            # same prefix, different length: point at the first extra
+            j = min(len(recorded), len(static))
+            div.update(index=j,
+                       recorded=recorded[j] if j < len(recorded) else None,
+                       static=static[j] if j < len(static) else None)
+        out.setdefault("divergence", div)
+    return out
+
+
+def gang_report(gang_dir: str) -> Tuple[dict, List[str], List[str]]:
+    """Merged multi-rank verdict for one gang run's trace sidecar dir.
+
+    Checks, per the flight-recorder contract ``distributed.gang``
+    guarantees on every exit path:
+
+    * every rank ``0..world_size-1`` (world size from the sidecar
+      headers) wrote a sidecar — a missing file means that rank died
+      without flushing, i.e. outside every guaranteed path;
+    * each sidecar's event stream contains the ``gang/exit`` terminal
+      barrier (finalize ran);
+    * every recorded 1F1B pipeline schedule is bit-identical to the
+      static model for its (pp, n_micro, overlap).
+
+    Returns (report, failures, errors): ``failures`` → exit 1,
+    ``errors`` (unreadable/corrupt input) → exit 2.
+    """
+    failures: List[str] = []
+    errors: List[str] = []
+    files = discover_sidecars([gang_dir], "trace_rank*.jsonl")
+    ranks: Dict[int, dict] = {}
+    for p in files:
+        try:
+            header, evs = read_sidecar(p, TRACE_SCHEMA)
+        except (OSError, ValueError) as exc:
+            errors.append(str(exc))
+            continue
+        rank = int(header.get("rank", 0))
+        terminal = next((e for e in evs
+                         if e.get("kind") == "barrier"
+                         and e.get("name") == GANG_TERMINAL_BARRIER),
+                        None)
+        row: Dict[str, Any] = {
+            "rank": rank,
+            "file": p,
+            "n_events": len(evs),
+            "world_size": header.get("world_size"),
+            "restart": header.get("restart"),
+            "status": header.get("status"),
+            "terminal_barrier": terminal is not None,
+        }
+        if terminal is not None:
+            row["terminal_status"] = terminal.get("status")
+            row["terminal_step"] = terminal.get("step")
+        else:
+            failures.append(
+                f"rank {rank}: no {GANG_TERMINAL_BARRIER!r} terminal "
+                f"barrier in {p} (finalize never ran)")
+        sched = _rank_schedule_verdict(evs)
+        row["schedule"] = sched
+        if sched is not None and not sched["matches_static"]:
+            failures.append(
+                f"rank {rank}: recorded 1F1B schedule diverges from the "
+                f"static model (pp={sched.get('pp')}, "
+                f"n_micro={sched.get('n_micro')}, "
+                f"overlap={sched.get('overlap')}) at event "
+                f"{sched['divergence'].get('index')}")
+        ranks[rank] = row
+    if not files:
+        errors.append(f"no trace sidecars found under {gang_dir} "
+                      "(looked for trace_rank*.jsonl)")
+    worlds = sorted({r["world_size"] for r in ranks.values()
+                     if r["world_size"] is not None})
+    if len(worlds) > 1:
+        failures.append(
+            f"sidecar headers disagree on world_size: {worlds}")
+    expected = worlds[-1] if worlds else len(ranks)
+    missing = [r for r in range(expected) if r not in ranks]
+    if missing:
+        failures.append(
+            f"missing sidecar(s) for rank(s) {missing}: expected "
+            f"{expected} ranks, found {sorted(ranks)}")
+    report = {
+        "tool": "trace_report",
+        "mode": "gang",
+        "version": 1,
+        "dir": gang_dir,
+        "files": files,
+        "world_size": expected,
+        "ranks_found": sorted(ranks),
+        "missing_ranks": missing,
+        "per_rank": [ranks[r] for r in sorted(ranks)],
+        "verdict": "pass" if not (failures or errors) else "fail",
+        "failures": failures,
+        "errors": errors,
+    }
+    return report, failures, errors
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -423,12 +606,30 @@ def main(argv=None) -> int:
     ap.add_argument("--black-box", metavar="OUT",
                     help="bundle sidecars + incidents + report into "
                          "one zip archive")
+    ap.add_argument("--gang", metavar="DIR", default=None,
+                    help="gang-run verdict mode: merge the dir's "
+                         "trace_rank*.jsonl sidecars, require every "
+                         "rank present with a gang/exit terminal "
+                         "barrier, and check each recorded 1F1B "
+                         "schedule against the static model; exit 1 "
+                         "on any failure")
     ap.add_argument("--request", type=int, default=None, metavar="RID",
                     help="include this request's full event timeline")
     ap.add_argument("--max-requests", type=int, default=50,
                     help="cap the per_request rows in the report "
                          "(default 50; stats use all rows)")
     args = ap.parse_args(argv)
+
+    if args.gang is not None:
+        report, failures, gang_errors = gang_report(args.gang)
+        json.dump(report, sys.stdout, indent=2, sort_keys=True,
+                  default=str)
+        sys.stdout.write("\n")
+        if gang_errors:
+            return 2
+        if failures:
+            return 1
+        return 0
 
     errors: List[str] = []
     warnings: List[str] = []
